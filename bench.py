@@ -1,0 +1,102 @@
+"""Benchmark: batched consensus-protocol simulation throughput on one chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The headline metric is simulated protocol events/sec across a vmapped batch
+of independent configurations — the device analogue of the reference's
+rayon-parallel simulation sweep (`fantoch_ps/src/bin/simulation.rs`). The
+baseline for `vs_baseline` is a single-threaded Python evaluation rate of
+~50k events/sec/core, the right order for the reference's per-core
+discrete-event loop (heap pop + protocol handler per event); >1 means one
+chip beats one CPU core sweeping the same grid.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import numpy as np
+
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.planet import Planet
+from fantoch_tpu.core.workload import KeyGen, Workload
+from fantoch_tpu.engine import setup, sweep
+from fantoch_tpu.protocols import basic as basic_proto
+
+# reference-scale single-core event rate (discrete-event loop on a modern
+# x86 core; see BASELINE.md — the reference publishes no absolute numbers, so
+# the sweep-throughput baseline is per-core event processing)
+BASELINE_EVENTS_PER_SEC = 50_000.0
+
+
+def build_batch(n_configs: int, commands_per_client: int = 50):
+    planet = Planet.new()
+    config = Config(n=3, f=1, gc_interval_ms=100)
+    workload = Workload(1, KeyGen.conflict_pool(100, 1), 1, commands_per_client, 100)
+    pdef = basic_proto.make_protocol(config.n, 1)
+    C = 4
+    spec = setup.build_spec(
+        config,
+        workload,
+        pdef,
+        n_clients=C,
+        n_client_groups=2,
+        max_steps=5_000_000,
+        extra_ms=1000,
+    )
+    placement = setup.Placement(
+        ["asia-east1", "us-central1", "us-west1"], ["us-west1", "us-west2"], 2
+    )
+    envs = []
+    for i in range(n_configs):
+        envs.append(
+            setup.build_env(spec, config, planet, placement, workload, pdef, seed=i)
+        )
+    return spec, pdef, workload, sweep.stack_envs(envs)
+
+
+def main():
+    n_configs = int(os.environ.get("BENCH_CONFIGS", "64"))
+    chunk_steps = int(os.environ.get("BENCH_CHUNK_STEPS", "20000"))
+    spec, pdef, wl, envs = build_batch(n_configs)
+
+    init, chunk, done = sweep.make_chunked_runner(spec, pdef, wl, chunk_steps)
+    # warm-up: compile both programs (init + chunk) on a throwaway state
+    warm = chunk(envs, init(envs))
+    jax.block_until_ready(warm)
+    del warm
+
+    # timed: a fresh full run, chunked until every config finishes
+    t0 = time.time()
+    st = init(envs)
+    while not done(st):
+        st = chunk(envs, st)
+    jax.block_until_ready(st)
+    elapsed = time.time() - t0
+
+    res = sweep.summarize_batch(st)
+    total_events = int(res["steps"].sum())
+    if not res["all_done"].all():
+        print(
+            json.dumps({"error": "simulation incomplete", "done": int(res["all_done"].sum())}),
+            file=sys.stderr,
+        )
+    events_per_sec = total_events / max(elapsed, 1e-9)
+    print(
+        json.dumps(
+            {
+                "metric": "simulated protocol events/sec/chip (Basic n=3, 64-config vmap sweep)",
+                "value": round(events_per_sec, 1),
+                "unit": "events/sec",
+                "vs_baseline": round(events_per_sec / BASELINE_EVENTS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,22 @@
+"""fantoch_tpu — a TPU-native framework for specifying, simulating, and
+evaluating planet-scale consensus protocols.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of
+bc-computing/fantoch: protocols (Basic, Tempo, Atlas/Janus, EPaxos, FPaxos,
+Caesar) are pure, vmappable step functions plugged into a protocol-agnostic
+lock-step discrete-event engine; config sweeps batch with `vmap` and shard
+over device meshes with `pjit`.
+
+Layout:
+- ``core``       ids, commands, config + quorum formulas, planet latencies,
+                 workload generators, metrics;
+- ``engine``     the lock-step simulator (`lockstep`), host setup (`setup`),
+                 batched sweeps (`sweep`);
+- ``protocols``  protocol step functions + shared machinery (synod, clocks);
+- ``executors``  ordering/execution engines (basic, table, graph, pred, slot);
+- ``planner``    closed-form latency planner (the bote equivalent);
+- ``parallel``   device-mesh sharding helpers;
+- ``ops``        batched kernels (segmented reductions, SCC).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,106 @@
+"""Protocol/system configuration and quorum-size formulas.
+
+Behavioral parity with the reference configuration (reference:
+`fantoch/src/config.rs`): same fields, same defaults, and — critically — the
+same quorum-size formulas for every protocol (`config.rs:278-349`), which the
+test-suite pins with the reference's own expected-value tables
+(`config.rs:352-602`).
+
+In the TPU build `Config` is host-side static metadata: per-config *dynamic*
+values that vary inside a vmapped sweep batch (f, conflict rate, latency
+matrix) are lowered into the engine's `Env` arrays; `Config` holds the static
+shape-bucket parameters.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass
+class Config:
+    """System configuration.
+
+    All intervals are integer milliseconds (the simulator is ms-granular, like
+    the reference's `SimTime`).
+    """
+
+    n: int
+    f: int
+    shard_count: int = 1
+
+    # executors
+    execute_at_commit: bool = False
+    executor_cleanup_interval_ms: int = 5
+    executor_monitor_pending_interval_ms: Optional[int] = None
+    executor_executed_notification_interval_ms: int = 50
+    executor_monitor_execution_order: bool = False
+
+    # garbage collection (None = disabled)
+    gc_interval_ms: Optional[int] = None
+
+    # leader-based protocols (FPaxos); process ids are 1-based like the
+    # reference's
+    leader: Optional[int] = None
+
+    # protocol flags
+    nfr: bool = False  # non-fault-tolerant reads
+    skip_fast_ack: bool = False
+    tempo_tiny_quorums: bool = False
+    tempo_clock_bump_interval_ms: Optional[int] = None
+    tempo_detached_send_interval_ms: Optional[int] = None
+    caesar_wait_condition: bool = True
+
+    def __post_init__(self) -> None:
+        # the reference checks f <= n/2 at construction (config.rs:53-55)
+        if self.f > self.n // 2:
+            raise ValueError(f"f = {self.f} is larger than a minority of n = {self.n}")
+
+    # ------------------------------------------------------------------
+    # quorum-size formulas (reference: fantoch/src/config.rs:278-349)
+    # ------------------------------------------------------------------
+
+    def majority_quorum_size(self) -> int:
+        return (self.n // 2) + 1
+
+    def basic_quorum_size(self) -> int:
+        return self.f + 1
+
+    def fpaxos_quorum_size(self) -> int:
+        return self.f + 1
+
+    def atlas_quorum_sizes(self) -> Tuple[int, int]:
+        """(fast_quorum_size, write_quorum_size)."""
+        fast = (self.n // 2) + self.f
+        write = self.f + 1
+        return fast, write
+
+    def epaxos_quorum_sizes(self) -> Tuple[int, int]:
+        """(fast_quorum_size, write_quorum_size).
+
+        EPaxos always tolerates a minority of failures: it uses f = n // 2
+        regardless of the configured f.
+        """
+        f = self.n // 2
+        fast = f + ((f + 1) // 2)
+        write = f + 1
+        return fast, write
+
+    def caesar_quorum_sizes(self) -> Tuple[int, int]:
+        fast = ((3 * self.n) // 4) + 1
+        write = (self.n // 2) + 1
+        return fast, write
+
+    def tempo_quorum_sizes(self) -> Tuple[int, int, int]:
+        """(fast_quorum_size, write_quorum_size, stability_threshold).
+
+        Stability threshold is n - fast_quorum_size + f in general; with tiny
+        quorums (fast quorum 2f, clocks from f+1 processes) it is n - f.
+        """
+        minority = self.n // 2
+        if self.tempo_tiny_quorums:
+            fast, threshold = 2 * self.f, self.n - self.f
+        else:
+            fast, threshold = minority + self.f, minority + 1
+        write = self.f + 1
+        return fast, write, threshold

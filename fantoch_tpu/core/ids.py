@@ -1,0 +1,31 @@
+"""Identifier encodings.
+
+Reference parity (`fantoch/src/id.rs`): a `Dot = (process, sequence)` names a
+command instance, a `Rifl = (client, sequence)` names a client request. On
+device both are dense int32 pairs; dots additionally flatten into an index
+into `[n * max_seq, ...]` per-protocol state tensors:
+
+    flat(dot) = process_index * max_seq + (sequence - 1)
+
+Process indices are 0-based on device; the reference's 1-based process ids
+(`util.rs:125-133` — ids must be non-zero because they double as paxos ballot
+seeds) appear only at the host boundary. Sequences are 1-based like the
+reference's `IdGen` so that "no dot yet" can be sequence 0.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def dot_flat(proc: jnp.ndarray, seq: jnp.ndarray, max_seq: int) -> jnp.ndarray:
+    """Flatten (0-based proc, 1-based seq) into a dense dot index."""
+    return proc.astype(jnp.int32) * max_seq + (seq.astype(jnp.int32) - 1)
+
+
+def dot_proc(flat: jnp.ndarray, max_seq: int) -> jnp.ndarray:
+    return flat // max_seq
+
+
+def dot_seq(flat: jnp.ndarray, max_seq: int) -> jnp.ndarray:
+    """1-based sequence of a flat dot."""
+    return flat % max_seq + 1

@@ -1,0 +1,168 @@
+"""Metrics: exact value-count histogram + metric kind registries.
+
+Behavioral parity with the reference metrics (reference:
+`fantoch/src/metrics/histogram.rs`, `fantoch/src/metrics/mod.rs`): the
+`Histogram` is an exact value→count map with the same mean / stddev / cov /
+mdtm (mean distance to mean) / percentile definitions, including the
+reference's midpoint percentile rule. On device the engine accumulates
+fixed-width bucketed count tensors (1 ms buckets) which convert losslessly to
+this exact histogram as long as no value clips past the last bucket (the
+engine tracks an overflow counter so clipping is detectable).
+
+`Metrics` mirrors the reference's dual store: histogram-`collected` kinds and
+u64-`aggregated` kinds (`metrics/mod.rs:16-68`).
+"""
+from __future__ import annotations
+
+import enum
+import math
+from typing import Dict, Iterable, Optional
+
+import numpy as np
+
+
+class Histogram:
+    """Exact value→count histogram over integer values (e.g. ms latencies)."""
+
+    def __init__(self) -> None:
+        self.values: Dict[int, int] = {}
+
+    @classmethod
+    def from_values(cls, values: Iterable[int]) -> "Histogram":
+        h = cls()
+        for v in values:
+            h.increment(int(v))
+        return h
+
+    @classmethod
+    def from_buckets(cls, counts: np.ndarray) -> "Histogram":
+        """Build from a dense [NB] count vector where bucket i = value i."""
+        h = cls()
+        counts = np.asarray(counts)
+        for v in np.nonzero(counts)[0]:
+            h.values[int(v)] = int(counts[v])
+        return h
+
+    def increment(self, value: int, count: int = 1) -> None:
+        self.values[value] = self.values.get(value, 0) + count
+
+    def merge(self, other: "Histogram") -> None:
+        for v, c in other.values.items():
+            self.increment(v, c)
+
+    def count(self) -> int:
+        return sum(self.values.values())
+
+    def _sum_and_count(self):
+        s = sum(v * c for v, c in self.values.items())
+        return s, self.count()
+
+    def mean(self) -> float:
+        s, c = self._sum_and_count()
+        return s / c if c else float("nan")
+
+    def stddev(self) -> float:
+        """Corrected sample standard deviation (n-1 divisor, histogram.rs:204-219).
+
+        NaN for 0/1 samples, matching the reference's f64 division semantics.
+        """
+        c = self.count()
+        if c < 2:
+            return float("nan")
+        mean = self.mean()
+        var = sum(((v - mean) ** 2) * n for v, n in self.values.items()) / (c - 1)
+        return math.sqrt(var)
+
+    def cov(self) -> float:
+        return self.stddev() / self.mean()
+
+    def mdtm(self) -> float:
+        """Mean distance to mean."""
+        mean = self.mean()
+        c = self.count()
+        return sum(abs(v - mean) * n for v, n in self.values.items()) / c
+
+    def min(self) -> float:
+        return float(min(self.values)) if self.values else float("nan")
+
+    def max(self) -> float:
+        return float(max(self.values)) if self.values else float("nan")
+
+    def percentile(self, percentile: float) -> float:
+        """Reference percentile rule (histogram.rs:111-166): index = p*count;
+        whole-number indexes take the midpoint of the straddling values."""
+        assert 0.0 <= percentile <= 1.0
+        if not self.values:
+            return 0.0
+        count = float(self.count())
+        index = percentile * count
+        index_rounded = round(index)
+        is_whole = abs(index - index_rounded) == 0.0
+        idx = int(index_rounded)
+
+        items = sorted(self.values.items())
+        left = right = None
+        for pos, (value, c) in enumerate(items):
+            if idx == c:
+                left = float(value)
+                right = float(items[pos + 1][0]) if pos + 1 < len(items) else None
+                break
+            elif idx < c:
+                left = float(value)
+                right = left
+                break
+            else:
+                idx -= c
+        if is_whole:
+            # at the very top of the histogram (e.g. percentile(1.0)) there is
+            # no right value; the maximum is the only sensible answer
+            return left if right is None else (left + right) / 2.0
+        return left
+
+    def __repr__(self) -> str:
+        return (
+            f"Histogram(count={self.count()}, mean={self.mean():.1f}, "
+            f"p99={self.percentile(0.99):.1f})"
+        )
+
+
+class ProtocolMetricsKind(enum.IntEnum):
+    """Reference `fantoch/src/protocol/mod.rs:184-199`."""
+
+    FAST_PATH = 0
+    SLOW_PATH = 1
+    STABLE = 2
+    COMMIT_LATENCY = 3
+    WAIT_CONDITION_DELAY = 4
+    COMMITTED_DEPS_LEN = 5
+    COMMAND_KEY_COUNT = 6
+
+
+class ExecutorMetricsKind(enum.IntEnum):
+    """Reference `fantoch/src/executor/mod.rs:123-130`."""
+
+    EXECUTION_DELAY = 0
+    CHAIN_SIZE = 1
+    OUT_REQUESTS = 2
+    IN_REQUESTS = 3
+    IN_REQUEST_REPLIES = 4
+
+
+class Metrics:
+    """Dual store: collected histograms + aggregated counters."""
+
+    def __init__(self) -> None:
+        self.collected: Dict[int, Histogram] = {}
+        self.aggregated: Dict[int, int] = {}
+
+    def collect(self, kind: int, value: int) -> None:
+        self.collected.setdefault(kind, Histogram()).increment(value)
+
+    def aggregate(self, kind: int, by: int) -> None:
+        self.aggregated[kind] = self.aggregated.get(kind, 0) + by
+
+    def get_collected(self, kind: int) -> Optional[Histogram]:
+        return self.collected.get(kind)
+
+    def get_aggregated(self, kind: int) -> Optional[int]:
+        return self.aggregated.get(kind)

@@ -1,0 +1,168 @@
+"""Geo-latency model ("planet").
+
+Behavioral parity with the reference planet (reference:
+`fantoch/src/planet/mod.rs`, `fantoch/src/planet/dat.rs`):
+
+- latencies between regions are *average* pings floored to integer ms
+  (`dat.rs:57-75`: `latency as u64` truncates);
+- intra-region latency is 0 (`planet/mod.rs:19`);
+- `sorted(region)` sorts by `(latency, region-name)` ascending
+  (`planet/mod.rs:121-139`);
+- process lists are sorted by the distance of their region, with ties broken
+  by process id (`fantoch/src/util.rs:152-185`);
+- `equidistant(distance, m)` builds a synthetic planet of regions `r_0..r_{m-1}`
+  all at the same distance.
+
+The TPU-facing surface is :meth:`Planet.distance_matrix_ms` and the helpers
+that turn region placements into dense int32 distance arrays (distance = half
+the ping, integer division — `sim/runner.rs:575-595`) which get batched over
+the config axis of the sweep engine.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_DATA_DIR = os.path.join(os.path.dirname(__file__), "..", "data", "latency")
+
+#: datasets shipped with the framework (converted from public ping
+#: measurements by tools/convert_latency_data.py)
+DATASETS = ("gcp", "aws_2020_06_05", "aws_2021_02_13")
+
+
+class Planet:
+    """Region-to-region latency matrix with distance helpers."""
+
+    def __init__(self, latencies: Dict[str, Dict[str, int]]):
+        # integer (floored) ms latencies
+        self.latencies = latencies
+        # per-region list of (latency, region) sorted ascending
+        self._sorted = {
+            src: sorted((lat, dst) for dst, lat in rows.items())
+            for src, rows in latencies.items()
+        }
+
+    # -- constructors ---------------------------------------------------
+
+    @classmethod
+    def from_dataset(cls, name: str = "gcp") -> "Planet":
+        path = os.path.join(_DATA_DIR, f"{name}.json")
+        with open(path) as f:
+            raw = json.load(f)
+        latencies = {
+            src: {dst: int(avg) for dst, avg in rows.items()}
+            for src, rows in raw.items()
+        }
+        return cls(latencies)
+
+    @classmethod
+    def new(cls) -> "Planet":
+        """GCP planet — the reference's `Planet::new`."""
+        return cls.from_dataset("gcp")
+
+    @classmethod
+    def from_latencies(cls, latencies: Dict[str, Dict[str, int]]) -> "Planet":
+        return cls(latencies)
+
+    @classmethod
+    def equidistant(cls, planet_distance: int, region_number: int) -> Tuple[List[str], "Planet"]:
+        regions = [f"r_{i}" for i in range(region_number)]
+        latencies = {
+            a: {b: (0 if a == b else planet_distance) for b in regions}
+            for a in regions
+        }
+        return regions, cls(latencies)
+
+    # -- queries --------------------------------------------------------
+
+    def regions(self) -> List[str]:
+        return list(self.latencies.keys())
+
+    def ping_latency(self, src: str, dst: str) -> Optional[int]:
+        rows = self.latencies.get(src)
+        if rows is None:
+            return None
+        return rows.get(dst)
+
+    def sorted(self, src: str) -> Optional[List[Tuple[int, str]]]:
+        return self._sorted.get(src)
+
+    # -- dense matrices for the device engine ---------------------------
+
+    def ping_matrix_ms(self, regions: Sequence[str]) -> np.ndarray:
+        """[R, R] int32 of floored average ping between the given regions."""
+        out = np.zeros((len(regions), len(regions)), dtype=np.int32)
+        for i, a in enumerate(regions):
+            for j, b in enumerate(regions):
+                lat = self.ping_latency(a, b)
+                if lat is None:
+                    raise KeyError(f"no latency {a} -> {b}")
+                out[i, j] = lat
+        return out
+
+    def one_way_delay(self, a: str, b: str, symmetric: bool = False) -> int:
+        """One-way message delay = ping // 2 (the simulator's distance rule,
+        reference `sim/runner.rs:575-595`); `symmetric` averages both pings
+        first (`make_distances_symmetric`)."""
+        lat = self.ping_latency(a, b)
+        if lat is None:
+            raise KeyError(f"no latency {a} -> {b}")
+        if symmetric:
+            back = self.ping_latency(b, a)
+            if back is None:
+                raise KeyError(f"no latency {b} -> {a}")
+            lat = (lat + back) // 2
+        return lat // 2
+
+    def distance_matrix_ms(
+        self,
+        from_regions: Sequence[str],
+        to_regions: Sequence[str],
+        symmetric: bool = False,
+    ) -> np.ndarray:
+        """[F, T] int32 one-way message delays (see `one_way_delay`)."""
+        out = np.zeros((len(from_regions), len(to_regions)), dtype=np.int32)
+        for i, a in enumerate(from_regions):
+            for j, b in enumerate(to_regions):
+                out[i, j] = self.one_way_delay(a, b, symmetric)
+        return out
+
+
+def process_ids(shard_id: int, n: int) -> List[int]:
+    """1-based process ids for a shard (reference `util.rs:125-133`)."""
+    shift = n * shard_id
+    return [i + shift for i in range(1, n + 1)]
+
+
+def sort_processes_by_distance(
+    region: str,
+    planet: Planet,
+    processes: Sequence[Tuple[int, int, str]],
+) -> List[Tuple[int, int]]:
+    """Sort `(process_id, shard_id, region)` triples by distance from `region`.
+
+    Processes in the same region are ordered by id (reference
+    `util.rs:152-185`: order comes from the planet's sorted-region index, ties
+    by process id).
+    """
+    sorted_regions = planet.sorted(region)
+    if sorted_regions is None:
+        raise KeyError(f"region {region} not on planet")
+    index = {r: i for i, (_lat, r) in enumerate(sorted_regions)}
+    ordered = sorted(processes, key=lambda t: (index[t[2]], t[0]))
+    return [(pid, sid) for pid, sid, _ in ordered]
+
+
+def closest_process_per_shard(
+    region: str,
+    planet: Planet,
+    processes: Sequence[Tuple[int, int, str]],
+) -> Dict[int, int]:
+    """shard_id -> closest process id (reference `util.rs:188-201`)."""
+    out: Dict[int, int] = {}
+    for pid, sid in sort_processes_by_distance(region, planet, processes):
+        out.setdefault(sid, pid)
+    return out

@@ -1,0 +1,182 @@
+"""Client workload generation as device-side PRNG kernels.
+
+Behavioral parity with the reference workload (reference:
+`fantoch/src/client/workload.rs`, `fantoch/src/client/key_gen.rs`):
+
+- ``ConflictPool {conflict_rate, pool_size}``: with probability
+  ``conflict_rate/100`` pick a uniform key from the shared conflict pool,
+  otherwise use the client's own unique key (`key_gen.rs:96-110`);
+- ``Zipf {coefficient, total_keys_per_shard}``: zipfian over the keyspace;
+- commands draw `keys_per_command` *distinct* keys by rejection
+  (`workload.rs:188-197`), are read-only with probability
+  ``read_only_percentage/100``, and carry an opaque payload.
+
+The TPU design replaces string keys with dense int32 key ids
+(`"CONFLICT{i}"`` → ``i``, a client's unique key → ``pool_size + client``;
+zipf key ``k`` → ``k``), since per-key protocol state lives in `[K, ...]`
+tensors. Randomness is counter-based (`jax.random.fold_in` on
+``(client, command_index)``) so command streams are reproducible and
+independent of evaluation order — statistically equivalent to the reference's
+`thread_rng`, not bit-identical (the reference makes no cross-run determinism
+promise either).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+KEYGEN_CONFLICT_POOL = 0
+KEYGEN_ZIPF = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class KeyGen:
+    kind: int
+    # conflict-pool params
+    conflict_rate: int = 0  # percentage, may be overridden per-config in a sweep
+    pool_size: int = 1
+    # zipf params
+    coefficient: float = 1.0
+    total_keys_per_shard: int = 64
+
+    @classmethod
+    def conflict_pool(cls, conflict_rate: int, pool_size: int) -> "KeyGen":
+        assert conflict_rate <= 100, "the conflict rate must be <= 100"
+        assert pool_size >= 1, "the pool size should be at least 1"
+        return cls(kind=KEYGEN_CONFLICT_POOL, conflict_rate=conflict_rate, pool_size=pool_size)
+
+    @classmethod
+    def zipf(cls, coefficient: float, total_keys_per_shard: int) -> "KeyGen":
+        return cls(
+            kind=KEYGEN_ZIPF,
+            coefficient=coefficient,
+            total_keys_per_shard=total_keys_per_shard,
+        )
+
+    def key_space(self, shard_count: int, n_clients: int) -> int:
+        """Number of dense int key ids this generator can produce."""
+        if self.kind == KEYGEN_CONFLICT_POOL:
+            return self.pool_size + n_clients
+        return self.total_keys_per_shard * shard_count
+
+
+@dataclasses.dataclass(frozen=True)
+class Workload:
+    """Workload spec (reference `workload.rs:13-67`)."""
+
+    shard_count: int
+    key_gen: KeyGen
+    keys_per_command: int
+    commands_per_client: int
+    payload_size: int = 0
+    read_only_percentage: int = 0
+
+    def __post_init__(self) -> None:
+        if self.key_gen.kind == KEYGEN_CONFLICT_POOL:
+            if self.key_gen.conflict_rate == 100 and self.keys_per_command > 1:
+                raise ValueError(
+                    "can't generate more than one key when the conflict_rate is 100"
+                )
+            if self.keys_per_command > 2:
+                raise ValueError(
+                    "can't generate more than two keys with the conflict-pool generator"
+                )
+
+    def key_space(self, n_clients: int) -> int:
+        return self.key_gen.key_space(self.shard_count, n_clients)
+
+
+def _zipf_cdf(coefficient: float, key_count: int) -> np.ndarray:
+    """CDF over ranks 1..key_count with weight rank^-coefficient."""
+    ranks = np.arange(1, key_count + 1, dtype=np.float64)
+    w = ranks ** (-float(coefficient))
+    cdf = np.cumsum(w) / np.sum(w)
+    return cdf.astype(np.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadConsts:
+    """Static + array constants consumed by the device sampler."""
+
+    kind: int
+    pool_size: int
+    keys_per_command: int
+    zipf_cdf: Optional[jnp.ndarray]  # [key_count] or None
+
+    @classmethod
+    def build(cls, w: Workload) -> "WorkloadConsts":
+        cdf = None
+        if w.key_gen.kind == KEYGEN_ZIPF:
+            cdf = jnp.asarray(
+                _zipf_cdf(w.key_gen.coefficient, w.key_gen.total_keys_per_shard * w.shard_count)
+            )
+        return cls(
+            kind=w.key_gen.kind,
+            pool_size=w.key_gen.pool_size,
+            keys_per_command=w.keys_per_command,
+            zipf_cdf=cdf,
+        )
+
+
+def _sample_one_key(consts: WorkloadConsts, rng, client: jnp.ndarray, conflict_rate: jnp.ndarray):
+    """Sample a single key id. `conflict_rate` is dynamic (sweep axis)."""
+    if consts.kind == KEYGEN_CONFLICT_POOL:
+        k_conf, k_pick = jax.random.split(rng)
+        roll = jax.random.randint(k_conf, (), 0, 100, dtype=jnp.int32)
+        conflict = roll < conflict_rate
+        pool_key = jax.random.randint(k_pick, (), 0, consts.pool_size, dtype=jnp.int32)
+        unique_key = consts.pool_size + client.astype(jnp.int32)
+        return jnp.where(conflict, pool_key, unique_key)
+    else:
+        u = jax.random.uniform(rng, ())
+        return jnp.searchsorted(consts.zipf_cdf, u).astype(jnp.int32)
+
+
+def sample_command_keys(
+    consts: WorkloadConsts,
+    seed_key,
+    client: jnp.ndarray,
+    cmd_index: jnp.ndarray,
+    conflict_rate: jnp.ndarray,
+    read_only_percentage: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Sample the keys + read-only flag for one command.
+
+    Returns (keys [keys_per_command] int32 distinct, read_only bool).
+    Distinctness uses bounded rejection (8 attempts) matching the reference's
+    rejection loop (`workload.rs:188-197`); with the conflict-pool generator
+    the second draw falls back to the client-unique key, which is always
+    distinct from a pool key.
+    """
+    kpc = consts.keys_per_command
+    rng = jax.random.fold_in(jax.random.fold_in(seed_key, client), cmd_index)
+    k_ro, rng = jax.random.split(rng)
+    ro_roll = jax.random.randint(k_ro, (), 0, 100, dtype=jnp.int32)
+    read_only = ro_roll < read_only_percentage
+
+    first = _sample_one_key(consts, jax.random.fold_in(rng, 0), client, conflict_rate)
+    keys = [first]
+    if kpc >= 2:
+        ATTEMPTS = 8
+
+        def body(i, carry):
+            key2, done = carry
+            cand = _sample_one_key(
+                consts, jax.random.fold_in(rng, 1 + i), client, conflict_rate
+            )
+            ok = jnp.logical_and(~done, cand != first)
+            return jnp.where(ok, cand, key2), jnp.logical_or(done, cand != first)
+
+        fallback = (
+            jnp.int32(consts.pool_size) + client.astype(jnp.int32)
+            if consts.kind == KEYGEN_CONFLICT_POOL
+            else (first + 1) % consts.zipf_cdf.shape[0]
+        )
+        key2, done = jax.lax.fori_loop(0, ATTEMPTS, body, (jnp.int32(0), jnp.bool_(False)))
+        key2 = jnp.where(done, key2, jnp.where(fallback != first, fallback, first + 1))
+        keys.append(key2)
+    return jnp.stack(keys), read_only

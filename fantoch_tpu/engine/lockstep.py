@@ -1,0 +1,575 @@
+"""Lock-step discrete-event simulation engine.
+
+The TPU-native replacement for the reference's single-threaded heap-driven
+simulator (reference: `fantoch/src/sim/{runner,schedule,simulation}.rs`). The
+semantics are the same — one event at a time, simulated time jumps to the next
+scheduled event, message delay between regions is half the ping latency
+(`runner.rs:575-595`), heap ties are broken arbitrarily (we make them
+deterministic by insertion order) — but the *mechanics* are tensorized so the
+whole simulation is a single `lax.while_loop` over a pytree of int32 arrays:
+
+- the binary-heap `Schedule` becomes a fixed-capacity message pool
+  `[S]` with a masked min-reduction as `pop`;
+- per-dot command metadata becomes dense `[n, DOTS]` tensors indexed by
+  flattened dots;
+- client closed loops, latency histograms and periodic events are all array
+  state.
+
+One engine step == one reference loop iteration. Nothing in here is
+protocol-specific: protocols plug in through `ProtocolDef`/`ExecutorDef`
+(engine/types.py). Because a config's entire simulation is a pure function
+`Env -> SimState`, thousands of independent configs batch with `vmap` (the
+device analogue of the reference's rayon sweep, `fantoch_ps/src/bin/
+simulation.rs:48-57`) and shard over a mesh with `pjit` (engine/sweep.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core import workload as workload_mod
+from ..core.ids import dot_flat
+from .types import (
+    INF_TIME,
+    KIND_PROTO_BASE,
+    KIND_SUBMIT,
+    KIND_TO_CLIENT,
+    CmdView,
+    Ctx,
+    ExecOut,
+    Outbox,
+    ProtocolDef,
+    ResOut,
+    bit,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class SimSpec:
+    """Static shape-bucket parameters of one simulation compile."""
+
+    n: int  # processes
+    n_clients: int
+    n_client_groups: int  # latency-histogram groups (client regions)
+    key_space: int
+    max_seq: int  # per-coordinator dot window
+    pool_slots: int  # in-flight message capacity
+    hist_buckets: int  # 1ms latency buckets
+    keys_per_command: int
+    commands_per_client: int
+    # resolved periodic intervals (ms); proto events come from
+    # ProtocolDef.periodic_events filtered to the enabled ones
+    proto_periodic_ms: Tuple[int, ...]
+    proto_periodic_kinds: Tuple[int, ...]  # protocol-side kind index per slot
+    executed_ms: Optional[int]  # executed-notification interval (None = off)
+    cleanup_ms: int  # executor drain tick
+    extra_ms: int  # extra simulated time after clients finish
+    reorder: bool  # random ×[0,10) message delay multiplier (sim_test mode)
+    max_steps: int
+    max_res: int  # executor results drained per call
+
+    @property
+    def dots(self) -> int:
+        return self.n * self.max_seq
+
+    @property
+    def n_periodic(self) -> int:
+        return len(self.proto_periodic_ms) + (self.executed_ms is not None) + 1
+
+
+class Env(NamedTuple):
+    """Per-configuration data — the batch axis of a sweep.
+
+    Everything that may vary across the config grid without changing shapes:
+    placement/distances, quorum composition, workload rates, RNG seed.
+    """
+
+    dist_pp: jnp.ndarray  # [n, n] int32, one-way delay (ping//2)
+    dist_pc: jnp.ndarray  # [n, C] int32 process->client delay
+    dist_cp: jnp.ndarray  # [C] int32 client->its coordinator delay
+    client_proc: jnp.ndarray  # [C] int32 coordinator process per client
+    client_group: jnp.ndarray  # [C] int32 histogram group (client region)
+    sorted_procs: jnp.ndarray  # [n, n] int32 processes sorted by distance per process
+    fq_mask: jnp.ndarray  # [n] int32 fast-quorum bitmask per process
+    wq_mask: jnp.ndarray  # [n] int32 write-quorum bitmask per process
+    maj_mask: jnp.ndarray  # [n] int32 majority-quorum bitmask per process
+    all_mask: jnp.ndarray  # int32 (1<<n)-1
+    f: jnp.ndarray  # int32
+    fq_size: jnp.ndarray  # int32
+    wq_size: jnp.ndarray  # int32
+    threshold: jnp.ndarray  # int32 (protocol-specific, e.g. Tempo stability)
+    leader: jnp.ndarray  # int32 0-based leader process (-1 if leaderless)
+    conflict_rate: jnp.ndarray  # int32 percentage
+    read_only_pct: jnp.ndarray  # int32 percentage
+    seed: jnp.ndarray  # PRNG key data (uint32[2])
+
+
+class SimState(NamedTuple):
+    now: jnp.ndarray
+    step: jnp.ndarray
+    seqno: jnp.ndarray
+    dropped: jnp.ndarray
+    # message pool
+    m_valid: jnp.ndarray  # [S] bool
+    m_time: jnp.ndarray  # [S] int32
+    m_seq: jnp.ndarray  # [S] int32 tie-break
+    m_src: jnp.ndarray  # [S] int32
+    m_dst: jnp.ndarray  # [S] int32
+    m_kind: jnp.ndarray  # [S] int32
+    m_payload: jnp.ndarray  # [S, W] int32
+    # command table
+    next_seq: jnp.ndarray  # [n] int32 next 1-based sequence per coordinator
+    cmd_client: jnp.ndarray  # [DOTS] int32
+    cmd_rifl: jnp.ndarray  # [DOTS] int32
+    cmd_keys: jnp.ndarray  # [DOTS, KPC] int32
+    cmd_ro: jnp.ndarray  # [DOTS] bool
+    # clients (closed loop, one outstanding command each)
+    c_start: jnp.ndarray  # [C] int32 submit wall-time of outstanding command
+    c_issued: jnp.ndarray  # [C] int32 commands issued so far
+    c_done: jnp.ndarray  # [C] bool
+    c_got: jnp.ndarray  # [C] int32 partial results received for outstanding cmd
+    clients_done: jnp.ndarray
+    final_time: jnp.ndarray
+    all_done: jnp.ndarray
+    # periodic timers [n, NPER]
+    per_next: jnp.ndarray
+    # latency metrics
+    hist: jnp.ndarray  # [G, NB] int32
+    hist_overflow: jnp.ndarray
+    lat_sum: jnp.ndarray  # [C] int32
+    lat_cnt: jnp.ndarray  # [C] int32
+    # plugged-in state
+    proto: Any
+    exec: Any
+
+
+class Candidates(NamedTuple):
+    """Pending pool insertions produced by one branch."""
+
+    valid: jnp.ndarray  # [CN] bool
+    time: jnp.ndarray  # [CN] int32
+    src: jnp.ndarray  # [CN] int32
+    dst: jnp.ndarray  # [CN] int32
+    kind: jnp.ndarray  # [CN] int32
+    payload: jnp.ndarray  # [CN, W] int32
+
+
+def _tree_select(pred, a, b):
+    return jax.tree_util.tree_map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def message_width(pdef: ProtocolDef, keys_per_command: int) -> int:
+    return max(pdef.msg_width, 3 + keys_per_command, 2)
+
+
+def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
+    """Build the engine for one (protocol, shape-bucket): an object with
+    `init_state(env)`, `run(env)`, and `run_chunk(env, st, k)`.
+
+    All returned functions are pure and traceable: `jax.jit(run)` for a
+    single config, `jax.jit(jax.vmap(run))` for a batch.
+    """
+    n, C, S = spec.n, spec.n_clients, spec.pool_slots
+    W = message_width(pdef, spec.keys_per_command)
+    KPC = spec.keys_per_command
+    DOTS = spec.dots
+    NB = spec.hist_buckets
+    NPER = spec.n_periodic
+    exdef = pdef.executor
+    consts = workload_mod.WorkloadConsts.build(wl)
+
+    # periodic interval table (static)
+    intervals = list(spec.proto_periodic_ms)
+    exec_notify_slot = None
+    if spec.executed_ms is not None:
+        exec_notify_slot = len(intervals)
+        intervals.append(spec.executed_ms)
+    cleanup_slot = len(intervals)
+    intervals.append(spec.cleanup_ms)
+    interval_arr = jnp.asarray(intervals, jnp.int32)  # [NPER]
+    assert NPER == len(intervals)
+
+    proc_ids = jnp.arange(n, dtype=jnp.int32)
+
+    # ------------------------------------------------------------------
+    # pool insertion
+    # ------------------------------------------------------------------
+
+    def _insert(st: SimState, cand: Candidates) -> SimState:
+        free = ~st.m_valid
+        rank = jnp.cumsum(free) - 1  # [S] rank among free slots
+        slot_for_rank = (
+            jnp.zeros((S,), jnp.int32)
+            .at[jnp.where(free, rank, S)]
+            .set(jnp.arange(S, dtype=jnp.int32), mode="drop")
+        )
+        n_free = free.sum()
+        crank = jnp.cumsum(cand.valid) - 1  # [CN]
+        ok = cand.valid & (crank < n_free)
+        slot = slot_for_rank[jnp.clip(crank, 0, S - 1)]
+        tgt = jnp.where(ok, slot, S)  # out-of-bounds => dropped by mode="drop"
+        return st._replace(
+            m_valid=st.m_valid.at[tgt].set(True, mode="drop"),
+            m_time=st.m_time.at[tgt].set(cand.time, mode="drop"),
+            m_seq=st.m_seq.at[tgt].set(st.seqno + crank, mode="drop"),
+            m_src=st.m_src.at[tgt].set(cand.src, mode="drop"),
+            m_dst=st.m_dst.at[tgt].set(cand.dst, mode="drop"),
+            m_kind=st.m_kind.at[tgt].set(cand.kind, mode="drop"),
+            m_payload=st.m_payload.at[tgt].set(cand.payload, mode="drop"),
+            seqno=st.seqno + cand.valid.sum(),
+            dropped=st.dropped + (cand.valid & ~ok).sum(),
+        )
+
+    def _delay(st: SimState, env: Env, base: jnp.ndarray) -> jnp.ndarray:
+        """Apply the optional random ×[0,10) reorder multiplier
+        (`sim/runner.rs:520-524`). Self-sends have base 0 and stay immediate."""
+        if not spec.reorder:
+            return base
+        key = jax.random.fold_in(jax.random.wrap_key_data(env.seed), st.seqno)
+        u = jax.random.uniform(key, base.shape, minval=0.0, maxval=10.0)
+        return jnp.floor(base.astype(jnp.float32) * u).astype(jnp.int32)
+
+    def _pad_payload(payload_cols: Sequence[jnp.ndarray], rows: int) -> jnp.ndarray:
+        """Stack int32 column vectors into a [rows, W] payload block."""
+        cols = [c.astype(jnp.int32).reshape(rows) for c in payload_cols]
+        block = jnp.stack(cols, axis=1)
+        pad = W - block.shape[1]
+        assert pad >= 0, f"payload wider than MSG_W: {block.shape[1]} > {W}"
+        if pad:
+            block = jnp.concatenate([block, jnp.zeros((rows, pad), jnp.int32)], axis=1)
+        return block
+
+    def _insert_outbox(st: SimState, env: Env, src_p, outbox: Outbox) -> SimState:
+        CN = pdef.max_out * n
+        valid = (outbox.valid[:, None] & (bit(outbox.tgt_mask[:, None], proc_ids[None, :]) == 1)).reshape(CN)
+        base = jnp.broadcast_to(env.dist_pp[src_p][None, :], (pdef.max_out, n)).reshape(CN)
+        time = st.now + _delay(st, env, base)
+        dst = jnp.broadcast_to(proc_ids[None, :], (pdef.max_out, n)).reshape(CN)
+        kind = jnp.broadcast_to(
+            (KIND_PROTO_BASE + outbox.kind)[:, None], (pdef.max_out, n)
+        ).reshape(CN)
+        # pad protocol payload width up to the engine message width
+        opay = outbox.payload
+        if opay.shape[1] < W:
+            opay = jnp.concatenate(
+                [opay, jnp.zeros((pdef.max_out, W - opay.shape[1]), jnp.int32)], axis=1
+            )
+        payload = jnp.broadcast_to(opay[:, None, :], (pdef.max_out, n, W)).reshape(CN, W)
+        src = jnp.full((CN,), src_p, jnp.int32)
+        return _insert(st, Candidates(valid, time, src, dst, kind, payload))
+
+    # ------------------------------------------------------------------
+    # executor plumbing
+    # ------------------------------------------------------------------
+
+    def _ctx(st: SimState, env: Env) -> Ctx:
+        return Ctx(
+            spec=spec,
+            env=env,
+            cmds=CmdView(st.cmd_client, st.cmd_rifl, st.cmd_keys, st.cmd_ro),
+        )
+
+    def _route_results(st: SimState, env: Env, p, res: ResOut) -> SimState:
+        MR = spec.max_res
+        # every replica executes, but only the submitting process has the
+        # command registered in its Pending (`runner.rs:351-362` wait_for) —
+        # results elsewhere are dropped (`add_executor_result` -> None)
+        cclip = jnp.clip(res.client, 0, C - 1)
+        valid = res.valid & (env.client_proc[cclip] == p)
+        res = res._replace(valid=valid)
+        cidx = jnp.where(valid, res.client, C)
+        got = st.c_got.at[cidx].add(1, mode="drop")
+        st = st._replace(c_got=got)
+        complete = res.valid & (got[cclip] == KPC)
+        # only the last partial result of a client in this batch completes it
+        same = res.client[None, :] == res.client[:, None]  # [MR, MR]
+        later = jnp.triu(same, k=1) & res.valid[None, :]
+        is_last = ~later.any(axis=1)
+        emit = complete & is_last
+        time = st.now + _delay(st, env, env.dist_pc[p, jnp.clip(res.client, 0, C - 1)])
+        payload = _pad_payload([res.client, res.rifl_seq], MR)
+        cand = Candidates(
+            valid=emit,
+            time=time,
+            src=jnp.full((MR,), p, jnp.int32),
+            dst=res.client,
+            kind=jnp.full((MR,), KIND_TO_CLIENT, jnp.int32),
+            payload=payload,
+        )
+        return _insert(st, cand)
+
+    def _apply_execout(st: SimState, env: Env, p, execout: ExecOut) -> SimState:
+        ctx = _ctx(st, env)
+        estate = st.exec
+        for i in range(pdef.max_exec):
+            new_est = exdef.handle(ctx, estate, p, execout.info[i], st.now)
+            estate = _tree_select(execout.valid[i], new_est, estate)
+        estate, res = exdef.drain(ctx, estate, p)
+        st = st._replace(exec=estate)
+        return _route_results(st, env, p, res)
+
+    # ------------------------------------------------------------------
+    # event branches
+    # ------------------------------------------------------------------
+
+    def _submit_branch(env, op):
+        st, src, dst, kind, payload = op
+        p = dst
+        client = payload[0]
+        rifl_seq = payload[1]
+        ro = payload[2].astype(jnp.bool_)
+        keys = payload[3 : 3 + KPC]
+        seq = st.next_seq[p]
+        ok = seq <= spec.max_seq  # dot-window overflow guard
+        flat = jnp.where(ok, dot_flat(p, seq, spec.max_seq), 0)
+        st = st._replace(
+            next_seq=st.next_seq.at[p].add(jnp.where(ok, 1, 0)),
+            dropped=st.dropped + (~ok).astype(jnp.int32),
+            cmd_client=st.cmd_client.at[flat].set(jnp.where(ok, client, st.cmd_client[flat])),
+            cmd_rifl=st.cmd_rifl.at[flat].set(jnp.where(ok, rifl_seq, st.cmd_rifl[flat])),
+            cmd_keys=st.cmd_keys.at[flat].set(jnp.where(ok, keys, st.cmd_keys[flat])),
+            cmd_ro=st.cmd_ro.at[flat].set(jnp.where(ok, ro, st.cmd_ro[flat])),
+            c_got=st.c_got.at[client].set(0, mode="drop"),
+        )
+        ctx = _ctx(st, env)
+        pst, outbox, execout = pdef.submit(ctx, st.proto, p, flat, st.now)
+        st = st._replace(proto=_tree_select(ok, pst, st.proto))
+        outbox = outbox._replace(valid=outbox.valid & ok)
+        execout = execout._replace(valid=execout.valid & ok)
+        st = _insert_outbox(st, env, p, outbox)
+        return _apply_execout(st, env, p, execout)
+
+    def _client_branch(env, op):
+        st, src, dst, kind, payload = op
+        c = payload[0]
+        lat = st.now - st.c_start[c]
+        g = env.client_group[c]
+        st = st._replace(
+            hist=st.hist.at[g, jnp.clip(lat, 0, NB - 1)].add(1),
+            hist_overflow=st.hist_overflow + (lat >= NB).astype(jnp.int32),
+            lat_sum=st.lat_sum.at[c].add(lat),
+            lat_cnt=st.lat_cnt.at[c].add(1),
+        )
+        more = st.c_issued[c] < spec.commands_per_client
+        keys, ro = workload_mod.sample_command_keys(
+            consts,
+            jax.random.wrap_key_data(env.seed),
+            c,
+            st.c_issued[c],
+            env.conflict_rate,
+            env.read_only_pct,
+        )
+        payload_row = _pad_payload(
+            [c[None], (st.c_issued[c] + 1)[None], ro.astype(jnp.int32)[None]]
+            + [keys[i][None] for i in range(KPC)],
+            1,
+        )
+        cand = Candidates(
+            valid=more[None],
+            time=(st.now + _delay(st, env, env.dist_cp[c][None])),
+            src=c[None],
+            dst=env.client_proc[c][None],
+            kind=jnp.full((1,), KIND_SUBMIT, jnp.int32),
+            payload=payload_row,
+        )
+        newly_done = ~more & ~st.c_done[c]
+        clients_done = st.clients_done + newly_done.astype(jnp.int32)
+        all_done = clients_done >= C
+        st = st._replace(
+            c_issued=st.c_issued.at[c].add(more.astype(jnp.int32)),
+            c_start=st.c_start.at[c].set(jnp.where(more, st.now, st.c_start[c])),
+            c_done=st.c_done.at[c].set(st.c_done[c] | ~more),
+            clients_done=clients_done,
+            final_time=jnp.where(
+                all_done & ~st.all_done, st.now + spec.extra_ms, st.final_time
+            ),
+            all_done=all_done,
+        )
+        return _insert(st, cand)
+
+    def _proto_branch(env, op):
+        st, src, dst, kind, payload = op
+        p = dst
+        ctx = _ctx(st, env)
+        pst, outbox, execout = pdef.handle(
+            ctx, st.proto, p, src, kind - KIND_PROTO_BASE, payload, st.now
+        )
+        st = st._replace(proto=pst)
+        st = _insert_outbox(st, env, p, outbox)
+        return _apply_execout(st, env, p, execout)
+
+    def _pool_branch(env, st: SimState) -> SimState:
+        # pop: min time, ties by insertion seq (deterministic; the reference's
+        # heap leaves same-time order unspecified)
+        times = jnp.where(st.m_valid, st.m_time, INF_TIME)
+        tmin = times.min()
+        seqs = jnp.where(st.m_valid & (st.m_time == tmin), st.m_seq, jnp.int32(2**30))
+        slot = jnp.argmin(seqs)
+        src = st.m_src[slot]
+        dst = st.m_dst[slot]
+        kind = st.m_kind[slot]
+        payload = st.m_payload[slot]
+        st = st._replace(m_valid=st.m_valid.at[slot].set(False))
+        op = (st, src, dst, kind, payload)
+        return jax.lax.switch(
+            jnp.clip(kind, 0, 2),
+            [
+                functools.partial(_submit_branch, env),
+                functools.partial(_client_branch, env),
+                functools.partial(_proto_branch, env),
+            ],
+            op,
+        )
+
+    def _periodic_branch(env, st: SimState) -> SimState:
+        flat_idx = jnp.argmin(st.per_next.reshape(-1))
+        p = (flat_idx // NPER).astype(jnp.int32)
+        k = (flat_idx % NPER).astype(jnp.int32)
+        st = st._replace(per_next=st.per_next.at[p, k].add(interval_arr[k]))
+
+        branches = []
+        for slot_i, proto_kind in enumerate(spec.proto_periodic_kinds):
+            def proto_ev(env, op, proto_kind=proto_kind):
+                st, p = op
+                ctx = _ctx(st, env)
+                pst, outbox = pdef.periodic(ctx, st.proto, p, proto_kind, st.now)
+                st = st._replace(proto=pst)
+                return _insert_outbox(st, env, p, outbox)
+            branches.append(functools.partial(proto_ev, env))
+        if exec_notify_slot is not None:
+            def exec_notify(env, op):
+                st, p = op
+                ctx = _ctx(st, env)
+                estate, info = exdef.executed(ctx, st.exec, p)
+                st = st._replace(exec=estate)
+                pst, outbox = pdef.handle_executed(ctx, st.proto, p, info, st.now)
+                st = st._replace(proto=pst)
+                return _insert_outbox(st, env, p, outbox)
+            branches.append(functools.partial(exec_notify, env))
+        def cleanup(env, op):
+            st, p = op
+            ctx = _ctx(st, env)
+            estate, res = exdef.drain(ctx, st.exec, p)
+            st = st._replace(exec=estate)
+            return _route_results(st, env, p, res)
+        branches.append(functools.partial(cleanup, env))
+        assert len(branches) == NPER
+
+        return jax.lax.switch(k, branches, (st, p))
+
+    # ------------------------------------------------------------------
+    # init / loop
+    # ------------------------------------------------------------------
+
+    def init_state(env: Env) -> SimState:
+        clients = jnp.arange(C, dtype=jnp.int32)
+        keys0, ro0 = jax.vmap(
+            lambda c: workload_mod.sample_command_keys(
+                consts,
+                jax.random.wrap_key_data(env.seed),
+                c,
+                jnp.int32(0),
+                env.conflict_rate,
+                env.read_only_pct,
+            )
+        )(clients)
+        # initial submits occupy pool slots 0..C-1
+        payload0 = jnp.zeros((S, W), jnp.int32)
+        payload0 = payload0.at[:C, 0].set(clients)
+        payload0 = payload0.at[:C, 1].set(1)
+        payload0 = payload0.at[:C, 2].set(ro0.astype(jnp.int32))
+        payload0 = payload0.at[:C, 3 : 3 + KPC].set(keys0)
+        st = SimState(
+            now=jnp.int32(0),
+            step=jnp.int32(0),
+            seqno=jnp.int32(C),
+            dropped=jnp.int32(0),
+            m_valid=jnp.arange(S) < C,
+            m_time=jnp.zeros((S,), jnp.int32).at[:C].set(env.dist_cp),
+            m_seq=jnp.arange(S, dtype=jnp.int32),
+            m_src=jnp.zeros((S,), jnp.int32).at[:C].set(clients),
+            m_dst=jnp.zeros((S,), jnp.int32).at[:C].set(env.client_proc),
+            m_kind=jnp.full((S,), KIND_SUBMIT, jnp.int32),
+            m_payload=payload0,
+            next_seq=jnp.ones((n,), jnp.int32),
+            cmd_client=jnp.zeros((DOTS,), jnp.int32),
+            cmd_rifl=jnp.zeros((DOTS,), jnp.int32),
+            cmd_keys=jnp.zeros((DOTS, KPC), jnp.int32),
+            cmd_ro=jnp.zeros((DOTS,), jnp.bool_),
+            c_start=jnp.zeros((C,), jnp.int32),
+            c_issued=jnp.ones((C,), jnp.int32),
+            c_done=jnp.zeros((C,), jnp.bool_),
+            c_got=jnp.zeros((C,), jnp.int32),
+            clients_done=jnp.int32(0),
+            final_time=INF_TIME,
+            all_done=jnp.bool_(False),
+            per_next=jnp.broadcast_to(interval_arr[None, :], (n, NPER)),
+            hist=jnp.zeros((spec.n_client_groups, NB), jnp.int32),
+            hist_overflow=jnp.int32(0),
+            lat_sum=jnp.zeros((C,), jnp.int32),
+            lat_cnt=jnp.zeros((C,), jnp.int32),
+            proto=pdef.init(spec, env),
+            exec=exdef.init(spec, env),
+        )
+        if spec.reorder:
+            # apply the reorder multiplier to the initial submits too
+            key = jax.random.fold_in(jax.random.wrap_key_data(env.seed), -1)
+            u = jax.random.uniform(key, (C,), minval=0.0, maxval=10.0)
+            t0 = jnp.floor(env.dist_cp.astype(jnp.float32) * u).astype(jnp.int32)
+            st = st._replace(m_time=st.m_time.at[:C].set(t0))
+        return st
+
+    def cond(st: SimState):
+        return (
+            ~(st.all_done & (st.now > st.final_time))
+            & (st.step < spec.max_steps)
+            & (st.now < INF_TIME)
+        )
+
+    def body(env: Env, st: SimState) -> SimState:
+        times = jnp.where(st.m_valid, st.m_time, INF_TIME)
+        t_pool = times.min()
+        t_per = st.per_next.min()
+        pool_first = t_pool <= t_per
+        st = st._replace(now=jnp.minimum(t_pool, t_per), step=st.step + 1)
+        return jax.lax.cond(
+            pool_first,
+            functools.partial(_pool_branch, env),
+            functools.partial(_periodic_branch, env),
+            st,
+        )
+
+    def run(env: Env) -> SimState:
+        return jax.lax.while_loop(cond, functools.partial(body, env), init_state(env))
+
+    def run_chunk(env: Env, st: SimState, chunk_steps: int) -> SimState:
+        """Advance at most `chunk_steps` events (early-exits when done).
+
+        Bounded-duration device programs: useful under remote/tunneled TPU
+        runtimes and for progress reporting between segments.
+        """
+        limit = st.step + chunk_steps
+        return jax.lax.while_loop(
+            lambda s: cond(s) & (s.step < limit),
+            functools.partial(body, env),
+            st,
+        )
+
+    class Engine:
+        pass
+
+    eng = Engine()
+    eng.spec = spec
+    eng.init_state = init_state
+    eng.run = run
+    eng.run_chunk = run_chunk
+    return eng
+
+
+def make_run(spec: SimSpec, pdef: ProtocolDef, wl):
+    """`run(env) -> SimState` for one (protocol, shape-bucket) — see make_engine."""
+    return make_engine(spec, pdef, wl).run

@@ -1,0 +1,195 @@
+"""Host-side construction of `SimSpec` + `Env` from Config/Planet/placement.
+
+This mirrors the reference runner's setup phase (reference:
+`fantoch/src/sim/runner.rs:64-190`): create processes per region, `discover`
+with the process list sorted by distance (which fixes quorum composition —
+`protocol/base.rs:62-147` takes the first `q` processes of the sorted list),
+connect each client to the closest process, and schedule the initial submits.
+Here all of that becomes dense arrays in `Env`; nothing below this layer uses
+strings or dicts.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..core.config import Config
+from ..core.planet import Planet, closest_process_per_shard, process_ids, sort_processes_by_distance
+from ..core.workload import Workload
+from .lockstep import Env, SimSpec
+from .types import ProtocolDef, mask_from_ids
+
+
+def build_spec(
+    config: Config,
+    workload: Workload,
+    pdef: ProtocolDef,
+    *,
+    n_clients: int,
+    n_client_groups: int,
+    zero_latency_clients: Optional[int] = None,
+    pool_slots: Optional[int] = None,
+    max_seq: Optional[int] = None,
+    hist_buckets: int = 2048,
+    extra_ms: int = 1000,
+    reorder: bool = False,
+    max_steps: int = 1 << 30,
+    max_res: int = 4,
+) -> SimSpec:
+    assert config.gc_interval_ms is not None, (
+        "the simulator requires gc to be running (reference runner.rs:75)"
+    )
+    total_cmds = n_clients * workload.commands_per_client
+    if max_seq is None:
+        # worst case: every command coordinated by one process
+        max_seq = total_cmds
+    if pool_slots is None:
+        # in-flight bound: a zero-latency client runs its whole closed loop in
+        # one simulated instant, leaving ~2(n-1) remote messages in flight per
+        # command — and *every* colocated zero-latency client does so in the
+        # same instant. Callers that know the placement can pass the exact
+        # count via `zero_latency_clients`; otherwise assume all clients might
+        # be colocated with their coordinator. On top: ~3 rounds of n messages
+        # per outstanding command and periodic GC fan-out.
+        zl = n_clients if zero_latency_clients is None else zero_latency_clients
+        pool_slots = max(
+            256,
+            2 * (config.n - 1) * workload.commands_per_client * max(zl, 1)
+            + 4 * n_clients * config.n
+            + 4 * config.n * config.n,
+        )
+
+    proto_ms: List[int] = []
+    proto_kinds: List[int] = []
+    for i, (_name, interval_fn) in enumerate(pdef.periodic_events):
+        ms = interval_fn(config)
+        if ms is not None:
+            proto_ms.append(int(ms))
+            proto_kinds.append(i)
+
+    executed_ms = (
+        config.executor_executed_notification_interval_ms
+        if pdef.handle_executed is not None
+        else None
+    )
+
+    return SimSpec(
+        n=config.n,
+        n_clients=n_clients,
+        n_client_groups=n_client_groups,
+        key_space=workload.key_space(n_clients),
+        max_seq=max_seq,
+        pool_slots=pool_slots,
+        hist_buckets=hist_buckets,
+        keys_per_command=workload.keys_per_command,
+        commands_per_client=workload.commands_per_client,
+        proto_periodic_ms=tuple(proto_ms),
+        proto_periodic_kinds=tuple(proto_kinds),
+        executed_ms=executed_ms,
+        cleanup_ms=config.executor_cleanup_interval_ms,
+        extra_ms=extra_ms,
+        reorder=reorder,
+        max_steps=max_steps,
+        max_res=max_res,
+    )
+
+
+@dataclasses.dataclass
+class Placement:
+    """Region placement of processes and clients."""
+
+    process_regions: Sequence[str]
+    client_regions: Sequence[str]
+    clients_per_region: int
+
+
+def build_env(
+    spec: SimSpec,
+    config: Config,
+    planet: Planet,
+    placement: Placement,
+    workload: Workload,
+    pdef: ProtocolDef,
+    seed: int = 0,
+    make_distances_symmetric: bool = False,
+) -> Env:
+    n = config.n
+    assert len(placement.process_regions) == n
+    C = len(placement.client_regions) * placement.clients_per_region
+    assert C == spec.n_clients
+
+    pids = process_ids(0, n)  # 1-based reference ids
+    triples = [
+        (pid, 0, region) for pid, region in zip(pids, placement.process_regions)
+    ]
+    id_to_idx = {pid: i for i, pid in enumerate(pids)}
+
+    # process-process one-way delays
+    dist_pp = planet.distance_matrix_ms(
+        placement.process_regions, placement.process_regions, make_distances_symmetric
+    )
+
+    # per-process sorted order + quorum masks
+    fq_size, wq_size, threshold = pdef.quorum_sizes(config)
+    maj_size = config.majority_quorum_size()
+    sorted_procs = np.zeros((n, n), np.int32)
+    fq_mask = np.zeros((n,), np.int32)
+    wq_mask = np.zeros((n,), np.int32)
+    maj_mask = np.zeros((n,), np.int32)
+    for i, region in enumerate(placement.process_regions):
+        order = [id_to_idx[pid] for pid, _sid in
+                 sort_processes_by_distance(region, planet, triples)]
+        sorted_procs[i] = order
+        fq_mask[i] = mask_from_ids(order[:fq_size], n)
+        wq_mask[i] = mask_from_ids(order[:wq_size], n)
+        maj_mask[i] = mask_from_ids(order[:maj_size], n)
+
+    # clients: region-major ordering like the reference's registration loop
+    client_proc = np.zeros((C,), np.int32)
+    client_group = np.zeros((C,), np.int32)
+    dist_cp = np.zeros((C,), np.int32)
+    dist_pc = np.zeros((n, C), np.int32)
+    c = 0
+    for g, region in enumerate(placement.client_regions):
+        closest = closest_process_per_shard(region, planet, triples)
+        p_idx = id_to_idx[closest[0]]
+        for _ in range(placement.clients_per_region):
+            client_proc[c] = p_idx
+            client_group[c] = g
+            dist_cp[c] = planet.one_way_delay(
+                region, placement.process_regions[p_idx], make_distances_symmetric
+            )
+            for i, pr in enumerate(placement.process_regions):
+                dist_pc[i, c] = planet.one_way_delay(
+                    pr, region, make_distances_symmetric
+                )
+            c += 1
+
+    leader = -1
+    if config.leader is not None:
+        leader = id_to_idx[config.leader]
+
+    kg = workload.key_gen
+    return Env(
+        dist_pp=np.asarray(dist_pp),
+        dist_pc=np.asarray(dist_pc),
+        dist_cp=np.asarray(dist_cp),
+        client_proc=np.asarray(client_proc),
+        client_group=np.asarray(client_group),
+        sorted_procs=np.asarray(sorted_procs),
+        fq_mask=np.asarray(fq_mask),
+        wq_mask=np.asarray(wq_mask),
+        maj_mask=np.asarray(maj_mask),
+        all_mask=np.int32((1 << n) - 1),
+        f=np.int32(config.f),
+        fq_size=np.int32(fq_size),
+        wq_size=np.int32(wq_size),
+        threshold=np.int32(threshold),
+        leader=np.int32(leader),
+        conflict_rate=np.int32(getattr(kg, "conflict_rate", 0)),
+        read_only_pct=np.int32(workload.read_only_percentage),
+        seed=np.asarray(jax.random.key_data(jax.random.key(seed))),
+    )

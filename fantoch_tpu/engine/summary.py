@@ -1,0 +1,62 @@
+"""Host-side extraction of simulation results.
+
+The device engine accumulates bucketed per-group latency counts; this module
+turns a finished `SimState` into the reference runner's return shape
+(reference: `fantoch/src/sim/runner.rs:202-231`): per-region latency
+histograms + issued-command counts, and per-process protocol metrics.
+"""
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..core.metrics import Histogram
+from .lockstep import Env, SimState
+from .types import ProtocolDef
+
+
+def check_sim_health(st: SimState) -> None:
+    """Raise if the run hit any capacity limit (results would be silently wrong).
+
+    Works on single and vmapped-batch states alike (all checks are sums /
+    alls over however many leading axes there are).
+    """
+    dropped = int(np.asarray(st.dropped).sum())
+    overflow = int(np.asarray(st.hist_overflow).sum())
+    if dropped:
+        raise RuntimeError(f"simulation dropped {dropped} messages (pool/dot overflow)")
+    if overflow:
+        raise RuntimeError(f"{overflow} latencies clipped past the histogram range")
+    # protocol/executor states flag their own capacity losses through leaves
+    # named "overflow" (e.g. the executor ready ring) — all must stay 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path((st.proto, st.exec))[0]:
+        name = str(path[-1]) if path else ""
+        if "overflow" in name:
+            total = int(np.asarray(leaf).sum())
+            if total:
+                raise RuntimeError(f"capacity overflow in state leaf {path}: {total}")
+    if not bool(np.asarray(st.all_done).all()):
+        raise RuntimeError("simulation ended before all clients finished")
+
+
+def client_latencies(
+    st: SimState, env: Env, client_regions: Sequence[str]
+) -> Dict[str, Tuple[int, Histogram]]:
+    """region -> (issued_commands, latency Histogram) — the reference's
+    `clients_latencies` shape."""
+    hist = np.asarray(st.hist)
+    issued = np.asarray(st.c_issued)
+    group = np.asarray(env.client_group)
+    out: Dict[str, Tuple[int, Histogram]] = {}
+    for g, region in enumerate(client_regions):
+        h = Histogram.from_buckets(hist[g])
+        out[region] = (int(issued[group == g].sum()), h)
+    return out
+
+
+def protocol_metrics(st: SimState, pdef: ProtocolDef) -> Dict[str, np.ndarray]:
+    if pdef.metrics is None:
+        return {}
+    return {k: np.asarray(v) for k, v in pdef.metrics(st.proto).items()}

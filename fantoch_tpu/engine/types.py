@@ -1,0 +1,174 @@
+"""Engine contracts: the TPU-native `Protocol` / `Executor` interface.
+
+This is the framework's central abstraction — the device-side equivalent of
+the reference's `Protocol` trait (`fantoch/src/protocol/mod.rs:41-115`) and
+`Executor` trait (`fantoch/src/executor/mod.rs:27-89`). A protocol is a set of
+*pure, traceable* handler functions over a struct-of-arrays state with a
+leading process axis; the engine (`engine/lockstep.py`) calls them inside a
+`lax.while_loop`, batches whole configurations with `vmap`, and shards config
+grids over a device mesh with `pjit`.
+
+Contract (mirroring the trait's discipline — no I/O inside protocols,
+explicit outboxes instead of drain iterators, simulated time injected):
+
+- ``submit(ctx, state, p, dot, now)``    — client command submitted at `p`
+  (`Protocol::submit`);
+- ``handle(ctx, state, p, src, kind, payload, now)`` — protocol message
+  (`Protocol::handle`), returns new state, an `Outbox` of protocol messages
+  and an `ExecOut` of execution infos for the paired executor;
+- ``periodic(ctx, state, p, kind, now)`` — periodic events
+  (`Protocol::handle_event`);
+- ``handle_executed`` — the executor→protocol committed/executed
+  notification used for GC by some protocols (`Protocol::handle_executed`).
+
+Messages are fixed-width int32 rows; targets are process *bitmasks* (n ≤ 32),
+the dense analogue of the reference's `Action::ToSend{target: HashSet}`.
+To-self messages ride the same pool with delay 0 (the reference delivers
+self-sends inline; a 0-delay slot is observationally equivalent and keeps the
+step function uniform).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+# engine-owned message kinds; protocol kinds start at KIND_PROTO_BASE
+KIND_SUBMIT = 0
+KIND_TO_CLIENT = 1
+KIND_PROTO_BASE = 2
+
+# "never" timestamp for disabled timers / empty pools
+INF_TIME = jnp.int32(2**30)
+
+
+class Outbox(NamedTuple):
+    """Fixed-capacity protocol-message outbox of one handler call."""
+
+    valid: jnp.ndarray  # [MAX_OUT] bool
+    tgt_mask: jnp.ndarray  # [MAX_OUT] int32 bitmask of destination processes
+    kind: jnp.ndarray  # [MAX_OUT] int32 protocol message kind
+    payload: jnp.ndarray  # [MAX_OUT, MSG_W] int32
+
+
+class ExecOut(NamedTuple):
+    """Execution infos emitted to the local executor (Protocol::to_executors)."""
+
+    valid: jnp.ndarray  # [MAX_EXEC] bool
+    info: jnp.ndarray  # [MAX_EXEC, EXEC_W] int32
+
+
+class ResOut(NamedTuple):
+    """Command results drained from an executor (Executor::to_clients)."""
+
+    valid: jnp.ndarray  # [MAX_RES] bool
+    client: jnp.ndarray  # [MAX_RES] int32
+    rifl_seq: jnp.ndarray  # [MAX_RES] int32
+
+
+def empty_outbox(max_out: int, msg_w: int) -> Outbox:
+    return Outbox(
+        valid=jnp.zeros((max_out,), jnp.bool_),
+        tgt_mask=jnp.zeros((max_out,), jnp.int32),
+        kind=jnp.zeros((max_out,), jnp.int32),
+        payload=jnp.zeros((max_out, msg_w), jnp.int32),
+    )
+
+
+def empty_execout(max_exec: int, exec_w: int) -> ExecOut:
+    return ExecOut(
+        valid=jnp.zeros((max_exec,), jnp.bool_),
+        info=jnp.zeros((max_exec, exec_w), jnp.int32),
+    )
+
+
+def empty_resout(max_res: int) -> ResOut:
+    return ResOut(
+        valid=jnp.zeros((max_res,), jnp.bool_),
+        client=jnp.zeros((max_res,), jnp.int32),
+        rifl_seq=jnp.zeros((max_res,), jnp.int32),
+    )
+
+
+class CmdView(NamedTuple):
+    """Read-only view of the dense command table (the device `Command`).
+
+    Commands are written once at submit time and referenced by flat dot index
+    afterwards; protocol messages carry dots, not payloads (the payload-present
+    handshake of the reference — `MStore` carrying `cmd` — is modeled by
+    per-process `has_cmd` bits inside protocol state).
+    """
+
+    client: jnp.ndarray  # [DOTS] int32 issuing client
+    rifl_seq: jnp.ndarray  # [DOTS] int32 client-side command index (1-based)
+    keys: jnp.ndarray  # [DOTS, KPC] int32 dense key ids
+    read_only: jnp.ndarray  # [DOTS] bool
+
+
+class Ctx(NamedTuple):
+    """Read-only context handed to every handler."""
+
+    spec: Any  # SimSpec (static)
+    env: Any  # Env (per-config arrays)
+    cmds: CmdView
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecutorDef:
+    """Ordering/execution engine paired with a protocol.
+
+    `handle` ingests one execution info (Executor::handle); ready results are
+    queued inside executor state and emitted by `drain` (bounded per call; the
+    engine drains after every handle batch and on periodic cleanup ticks, so
+    queues always empty — the bounded-output analogue of `to_clients_iter`).
+    """
+
+    name: str
+    exec_width: int
+    init: Callable[..., Any]  # (spec, env) -> estate pytree, leading axis n
+    handle: Callable[..., Any]  # (ctx, estate, p, info, now) -> estate
+    drain: Callable[..., Any]  # (ctx, estate, p) -> (estate, ResOut)
+    # optional committed/executed frontier notification (Executor::executed)
+    executed_width: int = 0
+    executed: Optional[Callable[..., Any]] = None  # (ctx, estate, p) -> (estate, info [executed_width])
+
+
+@dataclasses.dataclass(frozen=True)
+class ProtocolDef:
+    """A consensus protocol as a family of pure handlers (Protocol trait)."""
+
+    name: str
+    n_msg_kinds: int
+    msg_width: int
+    max_out: int
+    max_exec: int
+    executor: ExecutorDef
+    init: Callable[..., Any]  # (spec, env) -> pstate pytree, leading axis n
+    submit: Callable[..., Any]  # (ctx, pstate, p, dot, now) -> (pstate, Outbox, ExecOut)
+    handle: Callable[..., Any]  # (ctx, pstate, p, src, kind, payload, now) -> (pstate, Outbox, ExecOut)
+    # periodic protocol events: list of (name, interval_fn(config) -> Optional[ms])
+    periodic_events: Sequence[Tuple[str, Callable[[Any], Optional[int]]]] = ()
+    periodic: Optional[Callable[..., Any]] = None  # (ctx, pstate, p, kind, now) -> (pstate, Outbox)
+    # executor executed-notification consumer (Protocol::handle_executed)
+    handle_executed: Optional[Callable[..., Any]] = None  # (ctx, pstate, p, info, now) -> (pstate, Outbox)
+    # host-side: quorum sizes for Env construction -> (fast, write, stability_threshold)
+    quorum_sizes: Callable[[Any], Tuple[int, int, int]] = None
+    # whether this protocol requires a leader (FPaxos)
+    leaderless: bool = True
+    # protocol-metric extraction from final state -> dict of arrays
+    metrics: Optional[Callable[[Any], dict]] = None
+
+
+def mask_from_ids(ids, n: int) -> int:
+    """Host-side helper: bitmask from an iterable of 0-based process indices."""
+    m = 0
+    for i in ids:
+        assert 0 <= i < n <= 32
+        m |= 1 << i
+    return m
+
+
+def bit(mask: jnp.ndarray, i: jnp.ndarray) -> jnp.ndarray:
+    """Test bit `i` of `mask` (traceable)."""
+    return (mask >> i) & 1

@@ -1,0 +1,53 @@
+"""Basic executor: execute immediately on receipt, no ordering.
+
+Reference parity: `fantoch/src/executor/basic.rs` — each execution info is
+one `(rifl, key, ops)` tuple; the executor applies it to the KV store and
+emits the partial result for the client. On device the KV store is a dense
+`[n, K]` array of last-written values (key ids are dense ints, values are the
+writing command's identity — enough for read-your-writes semantics and for
+cross-replica order checking).
+
+Execution-info row layout (width 3): ``[client, rifl_seq, key]``.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from ..engine.types import ExecutorDef
+from .ready import ReadyRing, ready_drain, ready_init, ready_push
+
+EXEC_WIDTH = 3
+
+
+class BasicExecState(NamedTuple):
+    kvs: jnp.ndarray  # [n, K] int32 last writer (client * 2^16 + rifl_seq)
+    ready: ReadyRing
+
+
+def make_executor(n: int) -> ExecutorDef:
+    def init(spec, env):
+        return BasicExecState(
+            kvs=jnp.zeros((n, spec.key_space), jnp.int32),
+            ready=ready_init(n, max(2 * spec.n_clients, 8)),
+        )
+
+    def handle(ctx, est: BasicExecState, p, info, now):
+        client, rifl_seq, key = info[0], info[1], info[2]
+        return est._replace(
+            kvs=est.kvs.at[p, key].set(client * (1 << 16) + rifl_seq),
+            ready=ready_push(est.ready, p, client, rifl_seq),
+        )
+
+    def drain(ctx, est: BasicExecState, p):
+        ready, res = ready_drain(est.ready, p, ctx.spec.max_res)
+        return est._replace(ready=ready), res
+
+    return ExecutorDef(
+        name="basic",
+        exec_width=EXEC_WIDTH,
+        init=init,
+        handle=handle,
+        drain=drain,
+    )

@@ -1,0 +1,65 @@
+"""Ready-result ring buffer shared by executor implementations.
+
+The reference's executors push `ExecutorResult`s into a vector drained by
+`to_clients_iter` (reference: `fantoch/src/executor/mod.rs:57-66`). On device
+the unbounded vector becomes a fixed-capacity ring per process; the engine
+drains up to `max_res` entries after every handler call and on periodic
+cleanup ticks, so the ring never needs to hold more than the process's
+outstanding commands.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+
+from ..engine.types import ResOut
+
+
+class ReadyRing(NamedTuple):
+    client: jnp.ndarray  # [n, RQ] int32
+    rifl_seq: jnp.ndarray  # [n, RQ] int32
+    push: jnp.ndarray  # [n] int32 total pushed
+    pop: jnp.ndarray  # [n] int32 total popped
+    overflow: jnp.ndarray  # int32 pushes lost to a full ring (must stay 0)
+
+
+def ready_init(n: int, capacity: int) -> ReadyRing:
+    return ReadyRing(
+        client=jnp.zeros((n, capacity), jnp.int32),
+        rifl_seq=jnp.zeros((n, capacity), jnp.int32),
+        push=jnp.zeros((n,), jnp.int32),
+        pop=jnp.zeros((n,), jnp.int32),
+        overflow=jnp.int32(0),
+    )
+
+
+def ready_push(ring: ReadyRing, p, client, rifl_seq, enable=True) -> ReadyRing:
+    cap = ring.client.shape[1]
+    enable = jnp.asarray(enable)
+    full = (ring.push[p] - ring.pop[p]) >= cap
+    do = enable & ~full
+    idx = ring.push[p] % cap
+    return ring._replace(
+        client=ring.client.at[p, idx].set(jnp.where(do, client, ring.client[p, idx])),
+        rifl_seq=ring.rifl_seq.at[p, idx].set(
+            jnp.where(do, rifl_seq, ring.rifl_seq[p, idx])
+        ),
+        push=ring.push.at[p].add(do.astype(jnp.int32)),
+        overflow=ring.overflow + (enable & full).astype(jnp.int32),
+    )
+
+
+def ready_drain(ring: ReadyRing, p, max_res: int) -> Tuple[ReadyRing, ResOut]:
+    cap = ring.client.shape[1]
+    avail = ring.push[p] - ring.pop[p]
+    take = jnp.minimum(avail, max_res)
+    offs = jnp.arange(max_res, dtype=jnp.int32)
+    valid = offs < take
+    idx = (ring.pop[p] + offs) % cap
+    res = ResOut(
+        valid=valid,
+        client=ring.client[p, idx],
+        rifl_seq=ring.rifl_seq[p, idx],
+    )
+    return ring._replace(pop=ring.pop.at[p].add(take)), res

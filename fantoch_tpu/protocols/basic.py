@@ -1,0 +1,190 @@
+"""Basic protocol: f+1-ack replication, 100% fast path.
+
+Reference parity: `fantoch/src/protocol/basic.rs` — the trivial protocol used
+to validate the execution engines:
+
+- submit: coordinator picks a dot and sends `MStore{dot, cmd, quorum}` to all
+  (`basic.rs:170-186`);
+- `MStore`: store payload; quorum members ack the coordinator
+  (`basic.rs:188-227`);
+- `MStoreAck`: once `basic_quorum_size = f+1` acks arrive, `MCommit` to all
+  (`basic.rs:229-249`);
+- `MCommit`: emit per-key execution infos; buffer if the payload hasn't
+  arrived yet (`basic.rs:251-282`); track committed dots for GC (shared GC
+  module, see `protocols/common/gc.py`).
+
+Device layout: per-process per-dot bits (`has_cmd`, `acks`,
+`buffered_commit`) in `[n, DOTS]` tensors.
+
+Message kinds/payloads (int32 rows):
+- MSTORE    [dot, quorum_mask]
+- MSTOREACK [dot]
+- MCOMMIT   [dot]
+- MGC       [frontier_0 .. frontier_{n-1}]
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..engine.types import (
+    ExecOut,
+    Outbox,
+    ProtocolDef,
+    bit,
+    empty_execout,
+    empty_outbox,
+)
+from ..executors import basic as basic_executor
+from .common import gc as gc_mod
+
+MSTORE = 0
+MSTOREACK = 1
+MCOMMIT = 2
+MGC = 3
+N_KINDS = 4
+
+EV_GC = 0  # periodic event kind
+
+
+class BasicState(NamedTuple):
+    has_cmd: jnp.ndarray  # [n, DOTS] bool payload received
+    acks: jnp.ndarray  # [n, DOTS] int32 ack count at coordinator
+    buffered_commit: jnp.ndarray  # [n, DOTS] bool MCommit before MStore
+    gc: gc_mod.GCTrack
+    commit_count: jnp.ndarray  # [n] int32 commits handled
+
+
+def make_protocol(n: int, keys_per_command: int = 1) -> ProtocolDef:
+    KPC = keys_per_command
+    MSG_W = max(2, n)
+    MAX_OUT = 2
+    MAX_EXEC = KPC
+    exdef = basic_executor.make_executor(n)
+    EW = exdef.exec_width
+
+    def init(spec, env):
+        DOTS = spec.dots
+        return BasicState(
+            has_cmd=jnp.zeros((n, DOTS), jnp.bool_),
+            acks=jnp.zeros((n, DOTS), jnp.int32),
+            buffered_commit=jnp.zeros((n, DOTS), jnp.bool_),
+            gc=gc_mod.gc_init(n, DOTS),
+            commit_count=jnp.zeros((n,), jnp.int32),
+        )
+
+    def _outbox1(valid, tgt_mask, kind, payload_vals):
+        """Single-entry outbox helper."""
+        ob = empty_outbox(MAX_OUT, MSG_W)
+        payload = jnp.zeros((MSG_W,), jnp.int32)
+        for i, v in enumerate(payload_vals):
+            payload = payload.at[i].set(v)
+        return ob._replace(
+            valid=ob.valid.at[0].set(valid),
+            tgt_mask=ob.tgt_mask.at[0].set(tgt_mask),
+            kind=ob.kind.at[0].set(kind),
+            payload=ob.payload.at[0].set(payload),
+        )
+
+    def submit(ctx, st: BasicState, p, dot, now):
+        # MStore to all, fast quorum attached (basic.rs:170-186)
+        ob = _outbox1(jnp.bool_(True), ctx.env.all_mask, MSTORE, [dot, ctx.env.fq_mask[p]])
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def _commit(ctx, st: BasicState, p, dot, enable):
+        """Commit path (basic.rs:251-282): emit per-key execution infos and
+        record the dot as committed (inlines the self-forwarded MCommitDot)."""
+        execout = ExecOut(
+            valid=jnp.broadcast_to(enable, (MAX_EXEC,)),
+            info=jnp.stack(
+                [
+                    jnp.stack(
+                        [
+                            ctx.cmds.client[dot],
+                            ctx.cmds.rifl_seq[dot],
+                            ctx.cmds.keys[dot, k],
+                        ]
+                    )
+                    for k in range(KPC)
+                ]
+            ),
+        )
+        st = st._replace(
+            gc=gc_mod.gc_commit(st.gc, p, dot, enable, ctx.spec.max_seq),
+            commit_count=st.commit_count.at[p].add(enable.astype(jnp.int32)),
+        )
+        return st, execout
+
+    def h_mstore(ctx, st: BasicState, p, src, payload, now):
+        dot, quorum_mask = payload[0], payload[1]
+        st = st._replace(has_cmd=st.has_cmd.at[p, dot].set(True))
+        in_quorum = bit(quorum_mask, p) == 1
+        ob = _outbox1(in_quorum, jnp.int32(1) << src, MSTOREACK, [dot])
+        # flush a buffered commit now that the payload arrived
+        buffered = st.buffered_commit[p, dot]
+        st = st._replace(buffered_commit=st.buffered_commit.at[p, dot].set(False))
+        st, execout = _commit(ctx, st, p, dot, buffered)
+        return st, ob, execout
+
+    def h_mstoreack(ctx, st: BasicState, p, src, payload, now):
+        dot = payload[0]
+        acks = st.acks[p, dot] + 1
+        st = st._replace(acks=st.acks.at[p, dot].set(acks))
+        # all replies in: commit (basic.rs:237-248)
+        ob = _outbox1(acks == ctx.env.fq_size, ctx.env.all_mask, MCOMMIT, [dot])
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def h_mcommit(ctx, st: BasicState, p, src, payload, now):
+        dot = payload[0]
+        has = st.has_cmd[p, dot]
+        st = st._replace(
+            buffered_commit=st.buffered_commit.at[p, dot].set(
+                st.buffered_commit[p, dot] | ~has
+            )
+        )
+        st, execout = _commit(ctx, st, p, dot, has)
+        return st, empty_outbox(MAX_OUT, MSG_W), execout
+
+    def h_mgc(ctx, st: BasicState, p, src, payload, now):
+        st = st._replace(gc=gc_mod.gc_handle_mgc(st.gc, p, src, payload[:n]))
+        return st, empty_outbox(MAX_OUT, MSG_W), empty_execout(MAX_EXEC, EW)
+
+    def handle(ctx, st, p, src, kind, payload, now):
+        branches = [
+            functools.partial(h, ctx)
+            for h in (h_mstore, h_mstoreack, h_mcommit, h_mgc)
+        ]
+        return jax.lax.switch(kind, branches, st, p, src, payload, now)
+
+    def periodic(ctx, st: BasicState, p, kind, now):
+        # GarbageCollection: broadcast own committed clock (basic.rs:320-331)
+        all_but_me = ctx.env.all_mask & ~(jnp.int32(1) << p)
+        row = gc_mod.gc_frontier_row(st.gc, p)
+        ob = _outbox1(jnp.bool_(True), all_but_me, MGC, [row[a] for a in range(n)])
+        return st, ob
+
+    def metrics(st: BasicState):
+        return {
+            "stable": st.gc.stable_count,
+            "commits": st.commit_count,
+        }
+
+    return ProtocolDef(
+        name="basic",
+        n_msg_kinds=N_KINDS,
+        msg_width=MSG_W,
+        max_out=MAX_OUT,
+        max_exec=MAX_EXEC,
+        executor=exdef,
+        init=init,
+        submit=submit,
+        handle=handle,
+        periodic_events=(("garbage_collection", lambda cfg: cfg.gc_interval_ms),),
+        periodic=periodic,
+        quorum_sizes=lambda cfg: (cfg.basic_quorum_size(), 0, 0),
+        leaderless=True,
+        metrics=metrics,
+    )

@@ -1,0 +1,88 @@
+"""Commit-tracking garbage collection shared by protocols.
+
+Reference parity: `fantoch/src/protocol/gc/clock.rs` (`VClockGCTrack`) and its
+use in every protocol's `MCommitDot` / `MGarbageCollection` / `MStable`
+handlers (e.g. `fantoch/src/protocol/basic.rs:284-331`):
+
+- each process records locally-committed dots (an `AEClock` — here a dense
+  committed bitmap + per-coordinator contiguous frontier);
+- a periodic event broadcasts the committed frontier to all peers;
+- on receipt, peers join clocks (element-wise max) and compute the *stable*
+  frontier = meet across all processes (undefined until every peer has
+  reported once);
+- newly-stable dots beyond the previous watermark are counted into the
+  `Stable` metric (the reference counts dots removed by `cmds.gc`; dot
+  windows make that the same number).
+
+State layout: leading process axis `n`; dots flattened as
+`coordinator * max_seq + (seq-1)`.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...core import ids
+
+
+class GCTrack(NamedTuple):
+    committed: jnp.ndarray  # [n, DOTS] bool
+    frontier: jnp.ndarray  # [n, n] int32 own contiguous committed per coordinator
+    clock_of: jnp.ndarray  # [n, n, n] int32 peers' reported frontiers
+    heard_from: jnp.ndarray  # [n, n] bool
+    stable_wm: jnp.ndarray  # [n, n] int32 previous stable watermark
+    stable_count: jnp.ndarray  # [n] int32 Stable metric
+
+
+def gc_init(n: int, dots: int) -> GCTrack:
+    return GCTrack(
+        committed=jnp.zeros((n, dots), jnp.bool_),
+        frontier=jnp.zeros((n, n), jnp.int32),
+        clock_of=jnp.zeros((n, n, n), jnp.int32),
+        heard_from=jnp.zeros((n, n), jnp.bool_),
+        stable_wm=jnp.zeros((n, n), jnp.int32),
+        stable_count=jnp.zeros((n,), jnp.int32),
+    )
+
+
+def gc_commit(gc: GCTrack, p, dot, enable, max_seq: int) -> GCTrack:
+    """Record a committed dot (the inlined `MCommitDot` self-forward) and
+    advance the contiguous frontier for the dot's coordinator."""
+    committed = gc.committed.at[p, dot].set(gc.committed[p, dot] | enable)
+    a = ids.dot_proc(dot, max_seq)
+
+    def adv_cond(fr):
+        return (fr < max_seq) & committed[p, a * max_seq + jnp.clip(fr, 0, max_seq - 1)]
+
+    fr = jax.lax.while_loop(adv_cond, lambda fr: fr + 1, gc.frontier[p, a])
+    return gc._replace(
+        committed=committed,
+        frontier=gc.frontier.at[p, a].set(jnp.where(enable, fr, gc.frontier[p, a])),
+    )
+
+
+def gc_handle_mgc(gc: GCTrack, p, src, incoming: jnp.ndarray) -> GCTrack:
+    """Join a peer's committed clock and fold newly-stable dots into the
+    Stable metric (inlines the `MStable` self-forward)."""
+    n = gc.frontier.shape[0]
+    gc = gc._replace(
+        clock_of=gc.clock_of.at[p, src].set(jnp.maximum(gc.clock_of[p, src], incoming)),
+        heard_from=gc.heard_from.at[p, src].set(True),
+    )
+    others = jnp.arange(n) != p
+    all_heard = jnp.where(others, gc.heard_from[p], True).all()
+    peer_min = jnp.where(others[:, None], gc.clock_of[p], jnp.int32(2**30)).min(axis=0)
+    stable = jnp.minimum(gc.frontier[p], peer_min)
+    new_wm = jnp.maximum(gc.stable_wm[p], stable)  # never go backwards
+    gained = jnp.where(all_heard, (new_wm - gc.stable_wm[p]).sum(), 0)
+    return gc._replace(
+        stable_wm=gc.stable_wm.at[p].set(jnp.where(all_heard, new_wm, gc.stable_wm[p])),
+        stable_count=gc.stable_count.at[p].add(gained),
+    )
+
+
+def gc_frontier_row(gc: GCTrack, p) -> jnp.ndarray:
+    """The payload of a periodic `MGarbageCollection` broadcast."""
+    return gc.frontier[p]

@@ -1,0 +1,19 @@
+"""Test configuration: force CPU with a virtual 8-device mesh.
+
+The unit/golden tests run on CPU (the installed TPU plugin overrides
+JAX_PLATFORMS, so we use jax.config directly); multi-chip sharding logic is
+exercised on a virtual 8-device host mesh. Real-TPU execution paths are
+covered by bench.py and __graft_entry__.py.
+"""
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

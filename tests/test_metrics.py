@@ -1,0 +1,44 @@
+"""Histogram stat tests (reference: fantoch/src/metrics/histogram.rs tests)."""
+import numpy as np
+
+from fantoch_tpu.core.metrics import Histogram
+
+
+def test_stats():
+    # reference `stats_test` expectations (histogram.rs:406-431)
+    h = Histogram.from_values([1, 1, 1])
+    assert round(h.mean(), 1) == 1.0
+    assert round(h.cov(), 1) == 0.0
+    assert round(h.mdtm(), 1) == 0.0
+
+    h = Histogram.from_values([10, 20, 30])
+    assert round(h.mean(), 1) == 20.0
+    assert round(h.cov(), 1) == 0.5  # corrected sample stddev: sqrt(100)/20
+    assert round(h.mdtm(), 1) == 6.7
+
+    h = Histogram.from_values([10, 20])
+    assert round(h.mean(), 1) == 15.0
+    assert round(h.mdtm(), 1) == 5.0
+
+
+def test_percentile_midpoint_rule():
+    h = Histogram.from_values([10, 20, 30, 40])
+    # p50 over 4 values: index 2 is whole -> midpoint of 20 and 30
+    assert h.percentile(0.5) == 25.0
+    assert h.percentile(1.0) == 40.0
+
+
+def test_from_buckets_roundtrip():
+    counts = np.zeros(100, np.int32)
+    counts[34] = 50
+    counts[58] = 25
+    h = Histogram.from_buckets(counts)
+    assert h.count() == 75
+    assert h.values == {34: 50, 58: 25}
+
+
+def test_merge():
+    a = Histogram.from_values([1, 2])
+    b = Histogram.from_values([2, 3])
+    a.merge(b)
+    assert a.values == {1: 1, 2: 2, 3: 1}

@@ -1,0 +1,72 @@
+"""Planet latency-model tests (reference: fantoch/src/planet/mod.rs tests)."""
+import numpy as np
+
+from fantoch_tpu.core.planet import (
+    Planet,
+    closest_process_per_shard,
+    process_ids,
+    sort_processes_by_distance,
+)
+
+
+def test_gcp_dataset_loads():
+    planet = Planet.new()
+    assert len(planet.regions()) == 20
+    # intra-region latency is 0
+    assert planet.ping_latency("us-west1", "us-west1") == 0
+    # floored averages (us-west1.dat has 25.012 to us-west2)
+    assert planet.ping_latency("us-west1", "us-west2") == 25
+    assert planet.ping_latency("us-west1", "us-central1") == 34
+
+
+def test_gcp_symmetry_example():
+    # the reference's `latency` test: europe-west3 <-> us-central1 symmetric
+    planet = Planet.new()
+    assert planet.ping_latency("europe-west3", "us-central1") == planet.ping_latency(
+        "us-central1", "europe-west3"
+    )
+
+
+def test_equidistant():
+    regions, planet = Planet.equidistant(10, 4)
+    assert regions == ["r_0", "r_1", "r_2", "r_3"]
+    assert planet.ping_latency("r_0", "r_1") == 10
+    assert planet.ping_latency("r_2", "r_2") == 0
+
+
+def test_process_ids():
+    assert process_ids(0, 3) == [1, 2, 3]
+    assert process_ids(1, 3) == [4, 5, 6]
+    assert process_ids(2, 5) == [11, 12, 13, 14, 15]
+
+
+def test_sort_processes_by_distance():
+    planet = Planet.new()
+    triples = [
+        (1, 0, "asia-east1"),
+        (2, 0, "us-central1"),
+        (3, 0, "us-west1"),
+    ]
+    # from us-west1: self (0), us-central1 (34), asia-east1 (118)
+    assert sort_processes_by_distance("us-west1", planet, triples) == [
+        (3, 0),
+        (2, 0),
+        (1, 0),
+    ]
+    # ties (same region) break by process id
+    triples2 = [(2, 0, "us-west1"), (1, 0, "us-west1")]
+    assert sort_processes_by_distance("us-west1", planet, triples2) == [(1, 0), (2, 0)]
+
+
+def test_closest_process_per_shard():
+    planet = Planet.new()
+    triples = [(1, 0, "asia-east1"), (2, 0, "us-central1"), (3, 0, "us-west1")]
+    assert closest_process_per_shard("us-west2", planet, triples) == {0: 3}
+
+
+def test_distance_matrix():
+    planet = Planet.new()
+    d = planet.distance_matrix_ms(["us-west1", "us-west2"], ["us-west1", "us-west2"])
+    assert d.dtype == np.int32
+    assert d[0, 0] == 0
+    assert d[0, 1] == 12  # 25 // 2

@@ -1,0 +1,63 @@
+"""Convert ping-matrix `.dat` measurement data into the JSON format shipped with
+fantoch_tpu.
+
+The upstream measurement data (reference: `latency_gcp/*.dat`,
+`latency_aws/*/*.dat`; format documented at `fantoch/src/planet/dat.rs:30-75`)
+is one file per source region, one line per destination region:
+
+    min/avg/max/dev:region
+
+We keep only the average (the reference's `Planet` does the same,
+`dat.rs:57-75`) and store it as a float; consumers floor it to integer
+milliseconds exactly like the reference (`latency as u64` truncates).
+
+Usage: python tools/convert_latency_data.py
+"""
+import json
+import os
+import sys
+
+DATASETS = {
+    "gcp": "/root/reference/latency_gcp",
+    "aws_2020_06_05": "/root/reference/latency_aws/2020_06_05",
+    "aws_2021_02_13": "/root/reference/latency_aws/2021_02_13",
+}
+
+OUT_DIR = os.path.join(os.path.dirname(__file__), "..", "fantoch_tpu", "data", "latency")
+
+
+def parse_dat_dir(path):
+    latencies = {}
+    for fname in sorted(os.listdir(path)):
+        if not fname.endswith(".dat"):
+            continue
+        src = fname[: -len(".dat")]
+        rows = {}
+        with open(os.path.join(path, fname)) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                stats, region = line.split(":", 1)
+                avg = float(stats.split("/")[1])
+                # intra-region latency is defined as 0
+                rows[region] = 0.0 if region == src else avg
+        latencies[src] = rows
+    return latencies
+
+
+def main():
+    os.makedirs(OUT_DIR, exist_ok=True)
+    for name, path in DATASETS.items():
+        if not os.path.isdir(path):
+            print(f"skip {name}: {path} not found", file=sys.stderr)
+            continue
+        data = parse_dat_dir(path)
+        out = os.path.join(OUT_DIR, f"{name}.json")
+        with open(out, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+        print(f"wrote {out}: {len(data)} regions")
+
+
+if __name__ == "__main__":
+    main()

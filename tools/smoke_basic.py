@@ -1,0 +1,57 @@
+"""Quick smoke run of the lock-step engine with the Basic protocol."""
+import os, sys, time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import numpy as np
+
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.planet import Planet
+from fantoch_tpu.core.workload import KeyGen, Workload
+from fantoch_tpu.engine import lockstep, setup
+from fantoch_tpu.protocols import basic as basic_proto
+
+def main(commands_per_client=50, clients_per_region=1):
+    planet = Planet.new()
+    config = Config(n=3, f=1, gc_interval_ms=100)
+    workload = Workload(
+        shard_count=1,
+        key_gen=KeyGen.conflict_pool(conflict_rate=100, pool_size=1),
+        keys_per_command=1,
+        commands_per_client=commands_per_client,
+        payload_size=100,
+    )
+    pdef = basic_proto.make_protocol(config.n, workload.keys_per_command)
+    C = 2 * clients_per_region
+    spec = setup.build_spec(
+        config, workload, pdef, n_clients=C, n_client_groups=2,
+        extra_ms=1000, max_steps=2_000_000,
+    )
+    placement = setup.Placement(
+        process_regions=["asia-east1", "us-central1", "us-west1"],
+        client_regions=["us-west1", "us-west2"],
+        clients_per_region=clients_per_region,
+    )
+    env = setup.build_env(spec, config, planet, placement, workload, pdef)
+    run = jax.jit(lockstep.make_run(spec, pdef, workload))
+    t0 = time.time()
+    st = run(env)
+    st = jax.tree_util.tree_map(np.asarray, st)
+    t1 = time.time()
+    print(f"steps={st.step} now={st.now}ms dropped={st.dropped} "
+          f"overflow={st.hist_overflow} wall={t1-t0:.1f}s")
+    print("clients done:", st.clients_done, "issued:", st.c_issued)
+    for g, region in enumerate(placement.client_regions):
+        counts = st.hist[g]
+        total = counts.sum()
+        vals = np.nonzero(counts)[0]
+        mean = (vals * counts[vals]).sum() / max(total, 1)
+        print(f"  {region}: count={total} mean={mean:.2f}ms values={dict(zip(vals.tolist(), counts[vals].tolist()))}")
+    m = pdef.metrics(st.proto)
+    print("stable:", np.asarray(m["stable"]), "commits:", np.asarray(m["commits"]))
+    print("ready overflow:", np.asarray(st.exec.ready.overflow))
+
+if __name__ == "__main__":
+    main()
