@@ -96,7 +96,8 @@ class Histogram:
             return 0.0
         count = float(self.count())
         index = percentile * count
-        index_rounded = round(index)
+        # Rust f64::round() rounds half away from zero; Python round() banker's
+        index_rounded = math.floor(index + 0.5)
         is_whole = abs(index - index_rounded) == 0.0
         idx = int(index_rounded)
 

@@ -171,12 +171,19 @@ def sample_command_keys(
             ok = jnp.logical_and(~done, cand != first)
             return jnp.where(ok, cand, key2), jnp.logical_or(done, cand != first)
 
-        fallback = (
-            jnp.int32(consts.pool_size) + client.astype(jnp.int32)
-            if consts.kind == KEYGEN_CONFLICT_POOL
-            else (first + 1) % consts.zipf_cdf.shape[0]
-        )
+        if consts.kind == KEYGEN_CONFLICT_POOL:
+            # if the first key is the client-unique key, fall back to a pool
+            # key (never another client's unique key); otherwise the unique
+            # key is always distinct from the pool key drawn first
+            unique = jnp.int32(consts.pool_size) + client.astype(jnp.int32)
+            pool_key = jax.random.randint(
+                jax.random.fold_in(rng, 1 + ATTEMPTS), (), 0, consts.pool_size,
+                dtype=jnp.int32,
+            )
+            fallback = jnp.where(first == unique, pool_key, unique)
+        else:
+            fallback = (first + 1) % consts.zipf_cdf.shape[0]
         key2, done = jax.lax.fori_loop(0, ATTEMPTS, body, (jnp.int32(0), jnp.bool_(False)))
-        key2 = jnp.where(done, key2, jnp.where(fallback != first, fallback, first + 1))
+        key2 = jnp.where(done, key2, fallback)
         keys.append(key2)
     return jnp.stack(keys), read_only

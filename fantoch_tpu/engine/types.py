@@ -76,6 +76,20 @@ def empty_outbox(max_out: int, msg_w: int) -> Outbox:
     )
 
 
+def outbox_row(ob: Outbox, i: int, valid, tgt_mask, kind, payload_vals) -> Outbox:
+    """Fill row `i` of an outbox: zero-padded payload from a value list."""
+    msg_w = ob.payload.shape[1]
+    payload = jnp.zeros((msg_w,), jnp.int32)
+    for j, v in enumerate(payload_vals):
+        payload = payload.at[j].set(v)
+    return ob._replace(
+        valid=ob.valid.at[i].set(valid),
+        tgt_mask=ob.tgt_mask.at[i].set(jnp.asarray(tgt_mask, jnp.int32)),
+        kind=ob.kind.at[i].set(kind),
+        payload=ob.payload.at[i].set(payload),
+    )
+
+
 def empty_execout(max_exec: int, exec_w: int) -> ExecOut:
     return ExecOut(
         valid=jnp.zeros((max_exec,), jnp.bool_),

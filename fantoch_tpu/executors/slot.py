@@ -1,0 +1,83 @@
+"""Slot executor: total order by slot number.
+
+Reference parity: `fantoch_ps/src/executor/slot.rs` — commands arrive tagged
+with a consensus slot; the executor buffers them and executes strictly in
+slot order (`try_next_slot`, `slot.rs:89-96`). On device the unbounded
+`HashMap<Slot, Command>` becomes a dense `[n, SLOTS]` buffer of dot indices
+(-1 = empty) and `try_next_slot` is a bounded `lax.while_loop` that walks the
+contiguous prefix.
+
+Execution-info row layout (width 2): ``[slot, dot]`` — the command payload is
+read from the dense command table at execution time.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..engine.types import ExecutorDef
+from .ready import ReadyRing, ready_drain, ready_init, ready_push
+
+EXEC_WIDTH = 2
+
+
+class SlotExecState(NamedTuple):
+    kvs: jnp.ndarray  # [n, K] int32 last writer (client * 2^16 + rifl_seq)
+    next_slot: jnp.ndarray  # [n] int32 next slot to execute (1-based)
+    buf_dot: jnp.ndarray  # [n, SLOTS] int32 buffered dot per slot (-1 empty)
+    ready: ReadyRing
+
+
+def make_executor(n: int) -> ExecutorDef:
+    def init(spec, env):
+        SLOTS = spec.dots
+        return SlotExecState(
+            kvs=jnp.zeros((n, spec.key_space), jnp.int32),
+            next_slot=jnp.ones((n,), jnp.int32),
+            buf_dot=jnp.full((n, SLOTS), -1, jnp.int32),
+            ready=ready_init(n, 2 * spec.keys_per_command * spec.n_clients + 8),
+        )
+
+    def handle(ctx, est: SlotExecState, p, info, now):
+        KPC = ctx.spec.keys_per_command
+        SLOTS = est.buf_dot.shape[1]
+        slot, dot = info[0], info[1]
+        est = est._replace(buf_dot=est.buf_dot.at[p, slot - 1].set(dot))
+
+        # try_next_slot: execute the contiguous prefix (slot.rs:89-96)
+        def cond(e: SlotExecState):
+            nxt = e.next_slot[p]
+            return (nxt <= SLOTS) & (e.buf_dot[p, jnp.clip(nxt - 1, 0, SLOTS - 1)] >= 0)
+
+        def body(e: SlotExecState):
+            nxt = e.next_slot[p]
+            d = e.buf_dot[p, nxt - 1]
+            client = ctx.cmds.client[d]
+            rifl = ctx.cmds.rifl_seq[d]
+            kvs, ready = e.kvs, e.ready
+            for k in range(KPC):
+                key = ctx.cmds.keys[d, k]
+                kvs = kvs.at[p, key].set(client * (1 << 16) + rifl)
+                ready = ready_push(ready, p, client, rifl)
+            return e._replace(
+                kvs=kvs,
+                ready=ready,
+                buf_dot=e.buf_dot.at[p, nxt - 1].set(-1),
+                next_slot=e.next_slot.at[p].add(1),
+            )
+
+        return jax.lax.while_loop(cond, body, est)
+
+    def drain(ctx, est: SlotExecState, p):
+        ready, res = ready_drain(est.ready, p, ctx.spec.max_res)
+        return est._replace(ready=ready), res
+
+    return ExecutorDef(
+        name="slot",
+        exec_width=EXEC_WIDTH,
+        init=init,
+        handle=handle,
+        drain=drain,
+    )

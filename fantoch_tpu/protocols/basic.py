@@ -32,11 +32,11 @@ import jax.numpy as jnp
 
 from ..engine.types import (
     ExecOut,
-    Outbox,
     ProtocolDef,
     bit,
     empty_execout,
     empty_outbox,
+    outbox_row,
 )
 from ..executors import basic as basic_executor
 from .common import gc as gc_mod
@@ -46,8 +46,6 @@ MSTOREACK = 1
 MCOMMIT = 2
 MGC = 3
 N_KINDS = 4
-
-EV_GC = 0  # periodic event kind
 
 
 class BasicState(NamedTuple):
@@ -78,16 +76,7 @@ def make_protocol(n: int, keys_per_command: int = 1) -> ProtocolDef:
 
     def _outbox1(valid, tgt_mask, kind, payload_vals):
         """Single-entry outbox helper."""
-        ob = empty_outbox(MAX_OUT, MSG_W)
-        payload = jnp.zeros((MSG_W,), jnp.int32)
-        for i, v in enumerate(payload_vals):
-            payload = payload.at[i].set(v)
-        return ob._replace(
-            valid=ob.valid.at[0].set(valid),
-            tgt_mask=ob.tgt_mask.at[0].set(tgt_mask),
-            kind=ob.kind.at[0].set(kind),
-            payload=ob.payload.at[0].set(payload),
-        )
+        return outbox_row(empty_outbox(MAX_OUT, MSG_W), 0, valid, tgt_mask, kind, payload_vals)
 
     def submit(ctx, st: BasicState, p, dot, now):
         # MStore to all, fast quorum attached (basic.rs:170-186)

@@ -1,0 +1,261 @@
+"""FPaxos: flexible multi-decree Paxos (OPODIS'16), leader-based.
+
+Reference parity: `fantoch_ps/src/protocol/fpaxos.rs` +
+`fantoch_ps/src/protocol/common/synod/{multi,gc}.rs`:
+
+- submit at a non-leader forwards the command to the leader
+  (`MForwardSubmit`, `fpaxos.rs:182-193`);
+- the leader assigns the next slot under its initial ballot and spawns a
+  commander (`multi.rs:65-76,119-133`; the reference's self-forwarded
+  `MSpawnCommander` is inlined — our engine's 0-delay self-send of `MAccept`
+  to the write quorum, which includes the leader, is observationally the
+  same);
+- acceptors accept ballots >= their promised ballot and reply `MAccepted`
+  (`multi.rs:300-317`);
+- the commander collects f+1 accepts on its ballot, then broadcasts
+  `MChosen` (`multi.rs:240-252`, write quorum size `config.rs:290`);
+- `MChosen` emits a `SlotExecutionInfo` and feeds commit tracking
+  (`fpaxos.rs:317-337`);
+- GC: periodic broadcast of the contiguous-committed frontier; the stable
+  slot is the min over all processes; stable slots are removed from the
+  *acceptor* state, so only write-quorum members count them — total Stable
+  across processes is (f+1) x commands (`gc.rs:47-75`, `multi.rs:319-331`).
+
+Device layout: slots are dense 1-based indices into `[n, SLOTS]` tensors
+(acceptor / commander / commit-tracking state).
+
+Message kinds/payloads (int32 rows):
+- MFORWARD  [dot]
+- MACCEPT   [ballot, slot, dot]
+- MACCEPTED [ballot, slot]
+- MCHOSEN   [slot, dot]
+- MGC       [committed_frontier]
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..engine.types import (
+    ExecOut,
+    ProtocolDef,
+    empty_execout,
+    empty_outbox,
+    outbox_row,
+)
+from ..executors import slot as slot_executor
+
+MFORWARD = 0
+MACCEPT = 1
+MACCEPTED = 2
+MCHOSEN = 3
+MGC = 4
+N_KINDS = 5
+
+
+class FPaxosState(NamedTuple):
+    # leader (multi.rs:168-210)
+    last_slot: jnp.ndarray  # [n] int32 last slot assigned (leader only)
+    # acceptor (multi.rs:262-338)
+    acc_ballot: jnp.ndarray  # [n] int32 promised ballot
+    acc_has: jnp.ndarray  # [n, SLOTS] bool accepted entry exists
+    acc_dot: jnp.ndarray  # [n, SLOTS] int32 accepted value (dot)
+    # commanders (multi.rs:212-260)
+    cmdr_alive: jnp.ndarray  # [n, SLOTS] bool
+    cmdr_bal: jnp.ndarray  # [n, SLOTS] int32
+    cmdr_dot: jnp.ndarray  # [n, SLOTS] int32
+    cmdr_acks: jnp.ndarray  # [n, SLOTS] int32
+    # commit tracking (synod/gc.rs)
+    committed: jnp.ndarray  # [n, SLOTS] bool
+    frontier: jnp.ndarray  # [n] int32 contiguous-committed frontier
+    peer_committed: jnp.ndarray  # [n, n] int32 frontiers reported by peers
+    heard: jnp.ndarray  # [n, n] bool
+    prev_stable: jnp.ndarray  # [n] int32
+    stable_count: jnp.ndarray  # [n] int32 Stable metric
+    commit_count: jnp.ndarray  # [n] int32 MChosen handled
+
+
+def make_protocol(n: int, keys_per_command: int = 1) -> ProtocolDef:
+    MSG_W = 3
+    MAX_OUT = 2
+    MAX_EXEC = 1
+    exdef = slot_executor.make_executor(n)
+    EW = exdef.exec_width
+
+    def init(spec, env):
+        SLOTS = spec.dots
+        return FPaxosState(
+            last_slot=jnp.zeros((n,), jnp.int32),
+            # acceptors bootstrap by joining the initial leader's ballot
+            # (multi.rs:273-280); ballots are the 1-based leader id
+            acc_ballot=jnp.full((n,), env.leader + 1, jnp.int32),
+            acc_has=jnp.zeros((n, SLOTS), jnp.bool_),
+            acc_dot=jnp.zeros((n, SLOTS), jnp.int32),
+            cmdr_alive=jnp.zeros((n, SLOTS), jnp.bool_),
+            cmdr_bal=jnp.zeros((n, SLOTS), jnp.int32),
+            cmdr_dot=jnp.zeros((n, SLOTS), jnp.int32),
+            cmdr_acks=jnp.zeros((n, SLOTS), jnp.int32),
+            committed=jnp.zeros((n, SLOTS), jnp.bool_),
+            frontier=jnp.zeros((n,), jnp.int32),
+            peer_committed=jnp.zeros((n, n), jnp.int32),
+            heard=jnp.zeros((n, n), jnp.bool_),
+            prev_stable=jnp.zeros((n,), jnp.int32),
+            stable_count=jnp.zeros((n,), jnp.int32),
+            commit_count=jnp.zeros((n,), jnp.int32),
+        )
+
+    def _leader_assign(ctx, st: FPaxosState, p, dot, enable):
+        """Leader path: next slot + spawn commander + MAccept to the write
+        quorum (multi.rs:200-209,119-133). Returns (state, accept row)."""
+        slot = st.last_slot[p] + 1
+        idx = slot - 1
+        b0 = ctx.env.leader + 1
+        st = st._replace(
+            last_slot=st.last_slot.at[p].add(enable.astype(jnp.int32)),
+            cmdr_alive=st.cmdr_alive.at[p, idx].set(
+                jnp.where(enable, True, st.cmdr_alive[p, idx])
+            ),
+            cmdr_bal=st.cmdr_bal.at[p, idx].set(
+                jnp.where(enable, b0, st.cmdr_bal[p, idx])
+            ),
+            cmdr_dot=st.cmdr_dot.at[p, idx].set(
+                jnp.where(enable, dot, st.cmdr_dot[p, idx])
+            ),
+            cmdr_acks=st.cmdr_acks.at[p, idx].set(
+                jnp.where(enable, 0, st.cmdr_acks[p, idx])
+            ),
+        )
+        return st, (enable, ctx.env.wq_mask[p], MACCEPT, [b0, slot, dot])
+
+    def submit(ctx, st: FPaxosState, p, dot, now):
+        is_leader = p == ctx.env.leader
+        st, accept = _leader_assign(ctx, st, p, dot, is_leader)
+        ob = empty_outbox(MAX_OUT, MSG_W)
+        # non-leader: forward to the leader (fpaxos.rs:182-193)
+        ob = outbox_row(ob, 0, ~is_leader, jnp.int32(1) << ctx.env.leader, MFORWARD, [dot])
+        ob = outbox_row(ob, 1, *accept)
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def h_mforward(ctx, st: FPaxosState, p, src, payload, now):
+        dot = payload[0]
+        st, accept = _leader_assign(ctx, st, p, dot, p == ctx.env.leader)
+        ob = outbox_row(empty_outbox(MAX_OUT, MSG_W), 0, *accept)
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def h_maccept(ctx, st: FPaxosState, p, src, payload, now):
+        ballot, slot, dot = payload[0], payload[1], payload[2]
+        idx = slot - 1
+        ok = ballot >= st.acc_ballot[p]  # multi.rs:306
+        st = st._replace(
+            acc_ballot=st.acc_ballot.at[p].max(jnp.where(ok, ballot, 0)),
+            acc_has=st.acc_has.at[p, idx].set(st.acc_has[p, idx] | ok),
+            acc_dot=st.acc_dot.at[p, idx].set(jnp.where(ok, dot, st.acc_dot[p, idx])),
+        )
+        ob = outbox_row(
+            empty_outbox(MAX_OUT, MSG_W), 0, ok, jnp.int32(1) << src, MACCEPTED,
+            [ballot, slot],
+        )
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def h_maccepted(ctx, st: FPaxosState, p, src, payload, now):
+        ballot, slot = payload[0], payload[1]
+        idx = slot - 1
+        # only accepts on the commander's ballot count (multi.rs:240-252)
+        match = st.cmdr_alive[p, idx] & (st.cmdr_bal[p, idx] == ballot)
+        acks = st.cmdr_acks[p, idx] + match.astype(jnp.int32)
+        chosen = match & (acks == ctx.env.wq_size)
+        st = st._replace(
+            cmdr_acks=st.cmdr_acks.at[p, idx].set(acks),
+            cmdr_alive=st.cmdr_alive.at[p, idx].set(st.cmdr_alive[p, idx] & ~chosen),
+        )
+        ob = outbox_row(
+            empty_outbox(MAX_OUT, MSG_W), 0, chosen, ctx.env.all_mask, MCHOSEN,
+            [slot, st.cmdr_dot[p, idx]],
+        )
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def h_mchosen(ctx, st: FPaxosState, p, src, payload, now):
+        slot, dot = payload[0], payload[1]
+        idx = slot - 1
+        SLOTS = st.committed.shape[1]
+        committed = st.committed.at[p, idx].set(True)
+
+        def adv(fr):
+            return (fr < SLOTS) & committed[p, jnp.clip(fr, 0, SLOTS - 1)]
+
+        fr = jax.lax.while_loop(adv, lambda fr: fr + 1, st.frontier[p])
+        st = st._replace(
+            committed=committed,
+            frontier=st.frontier.at[p].set(fr),
+            commit_count=st.commit_count.at[p].add(1),
+        )
+        execout = ExecOut(
+            valid=jnp.ones((MAX_EXEC,), jnp.bool_),
+            info=jnp.stack([slot, dot])[None, :],
+        )
+        return st, empty_outbox(MAX_OUT, MSG_W), execout
+
+    def h_mgc(ctx, st: FPaxosState, p, src, payload, now):
+        SLOTS = st.committed.shape[1]
+        st = st._replace(
+            peer_committed=st.peer_committed.at[p, src].set(payload[0]),
+            heard=st.heard.at[p, src].set(True),
+        )
+        others = jnp.arange(n) != p
+        all_heard = jnp.where(others, st.heard[p], True).all()
+        peer_min = jnp.where(others, st.peer_committed[p], jnp.int32(2**30)).min()
+        stable = jnp.where(all_heard, jnp.minimum(st.frontier[p], peer_min), 0)
+        stable = jnp.maximum(st.prev_stable[p], stable)
+        # stable slots are removed from acceptor state; only acceptors that
+        # were contacted count them (multi.rs:319-331)
+        slots0 = jnp.arange(SLOTS, dtype=jnp.int32)  # 0-based = slot-1
+        in_range = (slots0 >= st.prev_stable[p]) & (slots0 < stable)
+        gained = (st.acc_has[p] & in_range).sum().astype(jnp.int32)
+        st = st._replace(
+            acc_has=st.acc_has.at[p].set(st.acc_has[p] & ~in_range),
+            prev_stable=st.prev_stable.at[p].set(stable),
+            stable_count=st.stable_count.at[p].add(gained),
+        )
+        return st, empty_outbox(MAX_OUT, MSG_W), empty_execout(MAX_EXEC, EW)
+
+    def handle(ctx, st, p, src, kind, payload, now):
+        branches = [
+            functools.partial(h, ctx)
+            for h in (h_mforward, h_maccept, h_maccepted, h_mchosen, h_mgc)
+        ]
+        return jax.lax.switch(kind, branches, st, p, src, payload, now)
+
+    def periodic(ctx, st: FPaxosState, p, kind, now):
+        # GarbageCollection: broadcast own committed frontier (fpaxos.rs:363-378)
+        all_but_me = ctx.env.all_mask & ~(jnp.int32(1) << p)
+        ob = outbox_row(
+            empty_outbox(MAX_OUT, MSG_W), 0, jnp.bool_(True), all_but_me, MGC,
+            [st.frontier[p]],
+        )
+        return st, ob
+
+    def metrics(st: FPaxosState):
+        return {
+            "stable": st.stable_count,
+            "commits": st.commit_count,
+        }
+
+    return ProtocolDef(
+        name="fpaxos",
+        n_msg_kinds=N_KINDS,
+        msg_width=MSG_W,
+        max_out=MAX_OUT,
+        max_exec=MAX_EXEC,
+        executor=exdef,
+        init=init,
+        submit=submit,
+        handle=handle,
+        periodic_events=(("garbage_collection", lambda cfg: cfg.gc_interval_ms),),
+        periodic=periodic,
+        quorum_sizes=lambda cfg: (0, cfg.fpaxos_quorum_size(), 0),
+        leaderless=False,
+        metrics=metrics,
+    )

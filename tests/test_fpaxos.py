@@ -1,0 +1,103 @@
+"""End-to-end golden tests for FPaxos + SlotExecutor.
+
+Mirrors the reference's sim-based protocol tests
+(`fantoch_ps/src/protocol/mod.rs:702-769` `sim_test::<FPaxos>`):
+
+- every command commits at every process;
+- GC completeness: total Stable across processes == (f+1) x commands — only
+  write-quorum acceptors hold slot state (`protocol/mod.rs:929-940`);
+- the simulated client latency matches the closed-form path through the
+  leader (submit -> forward -> accept round-trip over the write quorum ->
+  chosen -> reply), derived from the same GCP latency matrix the reference
+  tests use.
+"""
+import jax
+import numpy as np
+
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.planet import Planet
+from fantoch_tpu.core.workload import KeyGen, Workload
+from fantoch_tpu.engine import lockstep, setup, summary
+from fantoch_tpu.protocols import fpaxos as fpaxos_proto
+
+COMMANDS_PER_CLIENT = 20
+PROCESS_REGIONS = ["asia-east1", "us-central1", "us-west1", "us-west2", "europe-west2"]
+
+
+def run(n: int, f: int, leader_id: int, clients_per_region: int = 1):
+    planet = Planet.new()
+    config = Config(n=n, f=f, gc_interval_ms=50, leader=leader_id)
+    workload = Workload(
+        shard_count=1,
+        key_gen=KeyGen.conflict_pool(conflict_rate=50, pool_size=1),
+        keys_per_command=1,
+        commands_per_client=COMMANDS_PER_CLIENT,
+    )
+    pdef = fpaxos_proto.make_protocol(n, workload.keys_per_command)
+    process_regions = PROCESS_REGIONS[:n]
+    client_regions = ["us-west1", "us-west2"]
+    C = len(client_regions) * clients_per_region
+    spec = setup.build_spec(
+        config, workload, pdef, n_clients=C, n_client_groups=len(client_regions),
+        extra_ms=1000, max_steps=5_000_000,
+    )
+    placement = setup.Placement(process_regions, client_regions, clients_per_region)
+    env = setup.build_env(spec, config, planet, placement, workload, pdef)
+    st = jax.jit(lockstep.make_run(spec, pdef, workload))(env)
+    st = jax.tree_util.tree_map(np.asarray, st)
+    summary.check_sim_health(st)
+    lat = summary.client_latencies(st, env, client_regions)
+    metrics = summary.protocol_metrics(st, pdef)
+    return lat, metrics, planet, process_regions, client_regions
+
+
+def expected_latency_ms(
+    planet, process_regions, client_region, leader_idx, f
+) -> float:
+    """Closed-form FPaxos commit latency for a client (ms, one-way = ping/2)."""
+    def ow(a, b):
+        return planet.one_way_delay(a, b)
+
+    # client -> closest process
+    closest = min(range(len(process_regions)), key=lambda i: ow(client_region, process_regions[i]))
+    leader = process_regions[leader_idx]
+    d_sub = ow(client_region, process_regions[closest])
+    d_fwd = ow(process_regions[closest], leader)
+    # write quorum: f+1 processes closest to the leader (incl. itself);
+    # chosen when the (f+1)-th MAccepted arrives = max RTT over the quorum
+    rtts = sorted(2 * ow(leader, r) for r in process_regions)
+    d_quorum = rtts[f]  # rtts[0] == 0 (self)
+    d_chosen = ow(leader, process_regions[closest])
+    d_reply = ow(process_regions[closest], client_region)
+    return float(d_sub + d_fwd + d_quorum + d_chosen + d_reply)
+
+
+def check(n, f, leader_id, clients_per_region=1):
+    lat, metrics, planet, pregions, cregions = run(n, f, leader_id, clients_per_region)
+    total = 2 * clients_per_region * COMMANDS_PER_CLIENT
+    # every process commits every command (total order)
+    assert (metrics["commits"] == total).all(), metrics["commits"]
+    # GC completeness: only the f+1 write-quorum acceptors hold slot state
+    assert metrics["stable"].sum() == (f + 1) * total, metrics["stable"]
+    leader_idx = leader_id - 1
+    for region in cregions:
+        expected = expected_latency_ms(planet, pregions, region, leader_idx, f)
+        (issued, hist) = lat[region]
+        assert issued == clients_per_region * COMMANDS_PER_CLIENT
+        assert hist.mean() == expected, (region, hist.mean(), expected)
+
+
+def test_fpaxos_n3_f1():
+    check(3, 1, leader_id=1)
+
+
+def test_fpaxos_n5_f1():
+    check(5, 1, leader_id=1)
+
+
+def test_fpaxos_n5_f2():
+    check(5, 2, leader_id=2)
+
+
+def test_fpaxos_multiple_clients():
+    check(3, 1, leader_id=1, clients_per_region=3)
