@@ -244,21 +244,24 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         return block
 
     def _insert_outbox(st: SimState, env: Env, src_p, outbox: Outbox) -> SimState:
-        CN = pdef.max_out * n
+        # rows are derived from the outbox itself so periodic handlers may use
+        # wider outboxes than regular message handlers
+        rows = outbox.valid.shape[0]
+        CN = rows * n
         valid = (outbox.valid[:, None] & (bit(outbox.tgt_mask[:, None], proc_ids[None, :]) == 1)).reshape(CN)
-        base = jnp.broadcast_to(env.dist_pp[src_p][None, :], (pdef.max_out, n)).reshape(CN)
+        base = jnp.broadcast_to(env.dist_pp[src_p][None, :], (rows, n)).reshape(CN)
         time = st.now + _delay(st, env, base)
-        dst = jnp.broadcast_to(proc_ids[None, :], (pdef.max_out, n)).reshape(CN)
+        dst = jnp.broadcast_to(proc_ids[None, :], (rows, n)).reshape(CN)
         kind = jnp.broadcast_to(
-            (KIND_PROTO_BASE + outbox.kind)[:, None], (pdef.max_out, n)
+            (KIND_PROTO_BASE + outbox.kind)[:, None], (rows, n)
         ).reshape(CN)
         # pad protocol payload width up to the engine message width
         opay = outbox.payload
         if opay.shape[1] < W:
             opay = jnp.concatenate(
-                [opay, jnp.zeros((pdef.max_out, W - opay.shape[1]), jnp.int32)], axis=1
+                [opay, jnp.zeros((rows, W - opay.shape[1]), jnp.int32)], axis=1
             )
-        payload = jnp.broadcast_to(opay[:, None, :], (pdef.max_out, n, W)).reshape(CN, W)
+        payload = jnp.broadcast_to(opay[:, None, :], (rows, n, W)).reshape(CN, W)
         src = jnp.full((CN,), src_p, jnp.int32)
         return _insert(st, Candidates(valid, time, src, dst, kind, payload))
 
@@ -517,7 +520,7 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         )
         if spec.reorder:
             # apply the reorder multiplier to the initial submits too
-            key = jax.random.fold_in(jax.random.wrap_key_data(env.seed), -1)
+            key = jax.random.fold_in(jax.random.wrap_key_data(env.seed), 0x7FFFFFFF)
             u = jax.random.uniform(key, (C,), minval=0.0, maxval=10.0)
             t0 = jnp.floor(env.dist_cp.astype(jnp.float32) * u).astype(jnp.int32)
             st = st._replace(m_time=st.m_time.at[:C].set(t0))

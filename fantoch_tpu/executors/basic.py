@@ -16,7 +16,7 @@ from typing import NamedTuple
 import jax.numpy as jnp
 
 from ..engine.types import ExecutorDef
-from .ready import ReadyRing, ready_drain, ready_init, ready_push
+from .ready import ReadyRing, ready_capacity, ready_drain, ready_init, ready_push, writer_id
 
 EXEC_WIDTH = 3
 
@@ -36,7 +36,7 @@ def make_executor(n: int) -> ExecutorDef:
     def handle(ctx, est: BasicExecState, p, info, now):
         client, rifl_seq, key = info[0], info[1], info[2]
         return est._replace(
-            kvs=est.kvs.at[p, key].set(client * (1 << 16) + rifl_seq),
+            kvs=est.kvs.at[p, key].set(writer_id(client, rifl_seq)),
             ready=ready_push(est.ready, p, client, rifl_seq),
         )
 

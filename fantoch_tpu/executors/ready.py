@@ -16,6 +16,21 @@ import jax.numpy as jnp
 from ..engine.types import ResOut
 
 
+def writer_id(client, rifl_seq):
+    """KVS value written by a command: packed (client, rifl_seq) identifying
+    the last writer (the dense stand-in for the reference's opaque payload,
+    `fantoch/src/kvs.rs:53-65`). Assumes rifl_seq < 2^16."""
+    return client * (1 << 16) + rifl_seq
+
+
+def ready_capacity(spec) -> int:
+    """Worst-case ready-ring occupancy: a replica that no client is attached
+    to can lag arbitrarily and then execute its whole backlog in a single
+    handler call (one unlocking vote/slot releases everything), so the ring
+    must hold every key-entry of the run."""
+    return spec.n_clients * spec.commands_per_client * spec.keys_per_command + 8
+
+
 class ReadyRing(NamedTuple):
     client: jnp.ndarray  # [n, RQ] int32
     rifl_seq: jnp.ndarray  # [n, RQ] int32

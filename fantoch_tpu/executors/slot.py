@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from ..engine.types import ExecutorDef
-from .ready import ReadyRing, ready_drain, ready_init, ready_push
+from .ready import ReadyRing, ready_capacity, ready_drain, ready_init, ready_push, writer_id
 
 EXEC_WIDTH = 2
 
@@ -37,7 +37,7 @@ def make_executor(n: int) -> ExecutorDef:
             kvs=jnp.zeros((n, spec.key_space), jnp.int32),
             next_slot=jnp.ones((n,), jnp.int32),
             buf_dot=jnp.full((n, SLOTS), -1, jnp.int32),
-            ready=ready_init(n, 2 * spec.keys_per_command * spec.n_clients + 8),
+            ready=ready_init(n, ready_capacity(spec)),
         )
 
     def handle(ctx, est: SlotExecState, p, info, now):
@@ -59,7 +59,7 @@ def make_executor(n: int) -> ExecutorDef:
             kvs, ready = e.kvs, e.ready
             for k in range(KPC):
                 key = ctx.cmds.keys[d, k]
-                kvs = kvs.at[p, key].set(client * (1 << 16) + rifl)
+                kvs = kvs.at[p, key].set(writer_id(client, rifl))
                 ready = ready_push(ready, p, client, rifl)
             return e._replace(
                 kvs=kvs,

@@ -1,0 +1,215 @@
+"""Table executor: Tempo's timestamp-stability ordering engine.
+
+Reference parity: `fantoch_ps/src/executor/table/` — per-key `VotesTable`s
+collect vote ranges from all processes; a command committed at timestamp
+`clock` on key `k` executes once `clock` is *stable* on `k`, i.e. at least
+`stability_threshold` processes have voted every timestamp `<= clock`
+(`table/mod.rs:240-260` `stable_clock`), in `(clock, dot)` order
+(`table/mod.rs:140-168` sort id; `stable_ops:195-239`).
+
+TPU-native redesign (no translation of the BTreeMap/ARClock machinery):
+
+- the per-(key, voter) `ARClock` event set becomes a *frontier* int plus a
+  small fixed buffer of out-of-order pending ranges (`vt_ps/vt_pe`): a range
+  starting at `frontier+1` advances the frontier, others park in the buffer
+  until the gap fills. Vote generation is contiguous per (key, voter)
+  (`clocks/keys/sequential.rs:100-118` always votes `cur+1..=up_to`), so the
+  buffer only holds transiently-reordered chunks; duplicates are dropped.
+  Buffer exhaustion is counted in `vt_overflow` (an engine invariant:
+  tests assert it stays 0).
+- the per-key `BTreeMap<SortId, Pending>` becomes dense per-dot state
+  (`tbl_clock`, `tbl_pending[dot, key_slot]`); `stable_ops` is a bounded
+  while-loop popping the lexicographic-min `(clock, dot)` pending entry of
+  the key while its clock is stable.
+- the cross-replica `ExecutionOrderMonitor` (`fantoch/src/executor/
+  monitor.rs`) becomes a per-(process, key) rolling hash + count of executed
+  dots: equal hashes across replicas == identical per-key execution order.
+
+Execution-info rows (width 4 + 2n):
+- attached (`TableExecutionInfo::AttachedVotes`):
+  ``[0, key_slot, dot, clock, (start,end) per voter]``
+- detached (`TableExecutionInfo::DetachedVotes`):
+  ``[1, key, voter, start, end, 0...]``
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..engine.types import ExecutorDef
+from .ready import ReadyRing, ready_capacity, ready_drain, ready_init, ready_push, writer_id
+
+ATTACHED = 0
+DETACHED = 1
+
+# out-of-order vote-range buffer depth per (key, voter)
+PENDING_RANGES = 8
+
+ORDER_HASH_MULT = jnp.int32(0x01000193)  # FNV-ish odd multiplier
+
+
+def exec_width(n: int) -> int:
+    return 4 + 2 * n
+
+
+class TableExecState(NamedTuple):
+    kvs: jnp.ndarray  # [n, K] int32 last writer (client * 2^16 + rifl_seq)
+    # vote frontiers: votes [1..frontier] by `voter` on `key` all received
+    vt_frontier: jnp.ndarray  # [n, K, n] int32
+    vt_ps: jnp.ndarray  # [n, K, n, R] int32 pending range starts (0 = empty)
+    vt_pe: jnp.ndarray  # [n, K, n, R] int32 pending range ends
+    vt_overflow: jnp.ndarray  # int32 — must stay 0
+    # pending committed commands (the per-key sorted `ops` maps)
+    tbl_clock: jnp.ndarray  # [n, DOTS] int32 commit timestamp
+    tbl_pending: jnp.ndarray  # [n, DOTS, KPC] bool entry not yet executed
+    # execution-order monitor
+    order_hash: jnp.ndarray  # [n, K] int32 rolling hash of executed dots
+    order_cnt: jnp.ndarray  # [n, K] int32
+    executed_count: jnp.ndarray  # [n] int32 key-entries executed
+    ready: ReadyRing
+
+
+def make_executor(n: int) -> ExecutorDef:
+    EW = exec_width(n)
+    R = PENDING_RANGES
+
+    def init(spec, env):
+        DOTS = spec.dots
+        K = spec.key_space
+        KPC = spec.keys_per_command
+        return TableExecState(
+            kvs=jnp.zeros((n, K), jnp.int32),
+            vt_frontier=jnp.zeros((n, K, n), jnp.int32),
+            vt_ps=jnp.zeros((n, K, n, R), jnp.int32),
+            vt_pe=jnp.zeros((n, K, n, R), jnp.int32),
+            vt_overflow=jnp.int32(0),
+            tbl_clock=jnp.zeros((n, DOTS), jnp.int32),
+            tbl_pending=jnp.zeros((n, DOTS, KPC), jnp.bool_),
+            order_hash=jnp.zeros((n, K), jnp.int32),
+            order_cnt=jnp.zeros((n, K), jnp.int32),
+            executed_count=jnp.zeros((n,), jnp.int32),
+            ready=ready_init(n, ready_capacity(spec)),
+        )
+
+    def _add_range(est: TableExecState, p, key, voter, s, e):
+        """ARClock::add_range — advance the (key, voter) frontier or park the
+        range in the pending buffer; absorb newly-contiguous parked ranges."""
+        valid = s > 0
+        fr = est.vt_frontier[p, key, voter]
+        joins = valid & (s <= fr + 1)
+        fr = jnp.where(joins, jnp.maximum(fr, e), fr)
+
+        # park a non-contiguous new range in a free slot
+        park = valid & ~joins
+        free = est.vt_ps[p, key, voter] == 0
+        slot = jnp.argmax(free)
+        has_free = free.any()
+        do_park = park & has_free
+        ps = est.vt_ps.at[p, key, voter, slot].set(
+            jnp.where(do_park, s, est.vt_ps[p, key, voter, slot])
+        )
+        pe = est.vt_pe.at[p, key, voter, slot].set(
+            jnp.where(do_park, e, est.vt_pe[p, key, voter, slot])
+        )
+        overflow = est.vt_overflow + (park & ~has_free).astype(jnp.int32)
+
+        # absorb parked ranges that touch the (possibly advanced) frontier;
+        # each pass absorbs at least one range or stops, so R passes suffice
+        def absorb(_, carry):
+            fr, ps_row, pe_row = carry
+            touch = (ps_row > 0) & (ps_row <= fr + 1)
+            fr = jnp.where(touch.any(), jnp.maximum(fr, jnp.where(touch, pe_row, 0).max()), fr)
+            # drop absorbed ranges and stale duplicates (fully <= frontier)
+            drop = (ps_row > 0) & (pe_row <= fr)
+            ps_row = jnp.where(drop, 0, ps_row)
+            pe_row = jnp.where(drop, 0, pe_row)
+            return fr, ps_row, pe_row
+
+        fr, ps_row, pe_row = jax.lax.fori_loop(
+            0, R, absorb, (fr, ps[p, key, voter], pe[p, key, voter])
+        )
+        return est._replace(
+            vt_frontier=est.vt_frontier.at[p, key, voter].set(fr),
+            vt_ps=ps.at[p, key, voter].set(ps_row),
+            vt_pe=pe.at[p, key, voter].set(pe_row),
+            vt_overflow=overflow,
+        )
+
+    def _stable_ops(ctx, est: TableExecState, p, key):
+        """Execute every pending entry on `key` with clock <= stable clock,
+        in (clock, dot) order (table/mod.rs stable_ops + stable_clock)."""
+        KPC = ctx.spec.keys_per_command
+        DOTS = est.tbl_clock.shape[1]
+        threshold = ctx.env.threshold
+        # stable clock = threshold-th largest per-voter frontier
+        frontiers = jnp.sort(est.vt_frontier[p, key])  # ascending [n]
+        stable_clock = frontiers[n - threshold]
+
+        dots = jnp.arange(DOTS, dtype=jnp.int32)
+
+        def key_pending(e):
+            # [DOTS] does this dot have a pending entry on `key`?
+            on_key = (ctx.cmds.keys[:, :] == key) & e.tbl_pending[p]  # [DOTS, KPC]
+            return on_key.any(axis=1), on_key
+
+        def cond(e):
+            pend, _ = key_pending(e)
+            clocks = jnp.where(pend, e.tbl_clock[p], jnp.int32(2**30))
+            return clocks.min() <= stable_clock
+
+        def body(e):
+            pend, on_key = key_pending(e)
+            clocks = jnp.where(pend, e.tbl_clock[p], jnp.int32(2**30))
+            cmin = clocks.min()
+            # lexicographic (clock, dot) min: smallest dot at the min clock
+            d = jnp.where(clocks == cmin, dots, jnp.int32(2**30)).min()
+            client = ctx.cmds.client[d]
+            rifl = ctx.cmds.rifl_seq[d]
+            kslot = jnp.argmax(on_key[d])
+            return e._replace(
+                kvs=e.kvs.at[p, key].set(writer_id(client, rifl)),
+                tbl_pending=e.tbl_pending.at[p, d, kslot].set(False),
+                order_hash=e.order_hash.at[p, key].set(
+                    e.order_hash[p, key] * ORDER_HASH_MULT + (d + 1)
+                ),
+                order_cnt=e.order_cnt.at[p, key].add(1),
+                executed_count=e.executed_count.at[p].add(1),
+                ready=ready_push(e.ready, p, client, rifl),
+            )
+
+        return jax.lax.while_loop(cond, body, est)
+
+    def handle(ctx, est: TableExecState, p, info, now):
+        kind = info[0]
+
+        def attached(est):
+            kslot, dot, clock = info[1], info[2], info[3]
+            key = ctx.cmds.keys[dot, kslot]
+            est = est._replace(
+                tbl_clock=est.tbl_clock.at[p, dot].set(clock),
+                tbl_pending=est.tbl_pending.at[p, dot, kslot].set(True),
+            )
+            for v in range(n):
+                est = _add_range(est, p, key, v, info[4 + 2 * v], info[5 + 2 * v])
+            return _stable_ops(ctx, est, p, key)
+
+        def detached(est):
+            key, voter, s, e = info[1], info[2], info[3], info[4]
+            est = _add_range(est, p, key, voter, s, e)
+            return _stable_ops(ctx, est, p, key)
+
+        return jax.lax.cond(kind == ATTACHED, attached, detached, est)
+
+    def drain(ctx, est: TableExecState, p):
+        ready, res = ready_drain(est.ready, p, ctx.spec.max_res)
+        return est._replace(ready=ready), res
+
+    return ExecutorDef(
+        name="table",
+        exec_width=EW,
+        init=init,
+        handle=handle,
+        drain=drain,
+    )

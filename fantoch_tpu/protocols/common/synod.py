@@ -1,0 +1,93 @@
+"""Single-decree (flexible) Paxos per dot — the shared slow-path consensus.
+
+Reference parity: `fantoch_ps/src/protocol/common/synod/single.rs` — every
+leaderless protocol (Tempo, Atlas, EPaxos) embeds one `Synod` instance per
+dot for its slow path:
+
+- the original coordinator may *skip the prepare phase* with ballot =
+  its 1-based process id, safe because any later prepare uses a ballot > n
+  (`single.rs:87-92,208-213`);
+- acceptors accept `MAccept(b, v)` iff `b >= promised`, replying
+  `MAccepted(b)` (`single.rs:handle_accept`);
+- the proposer counts f+1 accepts on its current ballot, then the value is
+  chosen (`single.rs:316-330`);
+- `set_if_not_accepted` seeds the consensus value at `MCollect` time
+  (`single.rs:58-63`).
+
+Recovery (prepare/promise round) is not exercised by the reference either
+(`proposal_gen` is `todo!()`, `tempo.rs:1112-1115`); the state layout keeps
+the promised/accepted ballots separate so a recovery round can be added
+without reshaping.
+
+Device layout: one struct-of-arrays over `[n, DOTS]` — per-process,
+per-dot proposer + acceptor fields.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class SynodState(NamedTuple):
+    # acceptor (single.rs Acceptor)
+    acc_bal: jnp.ndarray  # [n, DOTS] int32 promised ballot (0 = none)
+    acc_abal: jnp.ndarray  # [n, DOTS] int32 ballot of accepted value (0 = none)
+    acc_val: jnp.ndarray  # [n, DOTS] int32 current consensus value
+    # proposer (single.rs Proposer)
+    prop_bal: jnp.ndarray  # [n, DOTS] int32 ballot in use (0 = none)
+    prop_val: jnp.ndarray  # [n, DOTS] int32 value proposed at prop_bal
+    prop_acks: jnp.ndarray  # [n, DOTS] int32 accepts on prop_bal
+
+
+def synod_init(n: int, dots: int) -> SynodState:
+    z = jnp.zeros((n, dots), jnp.int32)
+    return SynodState(z, z, z, z, z, z)
+
+
+def set_if_not_accepted(sy: SynodState, p, dot, value, enable=True) -> SynodState:
+    """Seed the consensus value unless some value was already accepted."""
+    ok = jnp.asarray(enable) & (sy.acc_abal[p, dot] == 0)
+    return sy._replace(
+        acc_val=sy.acc_val.at[p, dot].set(jnp.where(ok, value, sy.acc_val[p, dot]))
+    )
+
+
+def skip_prepare(sy: SynodState, p, dot, value, enable=True) -> SynodState:
+    """Start a phase-2-only round with ballot = 1-based own id; returns state
+    ready to count accepts for `value`."""
+    enable = jnp.asarray(enable)
+    ballot = p + 1
+
+    def setw(a, v):
+        return a.at[p, dot].set(jnp.where(enable, v, a[p, dot]))
+
+    return sy._replace(
+        prop_bal=setw(sy.prop_bal, ballot),
+        prop_val=setw(sy.prop_val, value),
+        prop_acks=setw(sy.prop_acks, 0),
+    )
+
+
+def handle_accept(sy: SynodState, p, dot, ballot, value):
+    """Acceptor side of `MAccept`: returns (state, accepted: bool)."""
+    ok = ballot >= sy.acc_bal[p, dot]
+
+    def setw(a, v):
+        return a.at[p, dot].set(jnp.where(ok, v, a[p, dot]))
+
+    sy = sy._replace(
+        acc_bal=setw(sy.acc_bal, ballot),
+        acc_abal=setw(sy.acc_abal, ballot),
+        acc_val=setw(sy.acc_val, value),
+    )
+    return sy, ok
+
+
+def handle_accepted(sy: SynodState, p, dot, ballot, write_quorum_size):
+    """Proposer side of `MAccepted`: returns (state, chosen: bool, value)."""
+    match = sy.prop_bal[p, dot] == ballot
+    acks = sy.prop_acks[p, dot] + match.astype(jnp.int32)
+    chosen = match & (acks == write_quorum_size)
+    sy = sy._replace(prop_acks=sy.prop_acks.at[p, dot].set(acks))
+    return sy, chosen, sy.prop_val[p, dot]

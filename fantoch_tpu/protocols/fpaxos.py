@@ -79,6 +79,9 @@ class FPaxosState(NamedTuple):
 
 
 def make_protocol(n: int, keys_per_command: int = 1) -> ProtocolDef:
+    # `keys_per_command` is accepted for factory-signature uniformity across
+    # protocols; the slot executor reads it from `ctx.spec` instead
+    del keys_per_command
     MSG_W = 3
     MAX_OUT = 2
     MAX_EXEC = 1

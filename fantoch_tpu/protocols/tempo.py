@@ -1,0 +1,546 @@
+"""Tempo: timestamp-stability consensus (EuroSys'21), leaderless.
+
+Reference parity: `fantoch_ps/src/protocol/tempo.rs` +
+`fantoch_ps/src/protocol/common/table/` — the flagship protocol:
+
+- submit: coordinator computes a timestamp proposal by bumping the clocks of
+  the command's keys (votes = the bumped ranges), sends
+  `MCollect{dot, cmd, quorum, clock}` to all (`tempo.rs:267-343`);
+- fast-quorum members make their own proposal with the remote clock as a
+  minimum and reply `MCollectAck{clock, process_votes}`; non-quorum members
+  just record the payload (`tempo.rs:345-465`);
+- the coordinator aggregates acks; once all fast-quorum clocks are in, the
+  fast path is taken iff the max clock was reported by at least
+  `quorum_size - minority` processes (`tempo.rs:524-537`); otherwise the
+  final clock goes through single-decree synod with a skipped prepare phase
+  (slow path, `tempo.rs:558-570,737-830`);
+- `MCommit{dot, clock, votes}` feeds each key's attached votes to the
+  `TableExecutor`, which executes commands in `(clock, dot)` order once
+  their timestamp is stable (`tempo.rs:575-674`);
+- clock bumps that are not attached to any commit are *detached votes*,
+  needed so stability keeps advancing (`tempo.rs:991-1026`).
+
+TPU-native deviations (behavior-preserving, timing-differing):
+- votes ride messages as dense `[KPC, n]` (start, end) range tensors; the
+  attached/detached partition of each (key, voter) vote sequence is exactly
+  the reference's;
+- detached votes are broadcast *eagerly* as single-range `MDetached` rows at
+  generation time instead of being buffered for the periodic `SendDetached`
+  event (`tempo.rs:1013-1026`) — equivalent to that interval being ~0; this
+  removes the unbounded host-side `Votes` buffer that has no dense analogue.
+  Stability is reached no later than in the reference;
+- `MCommitClock` (`tempo.rs:684-700`) is inlined: `max_commit_clock` is
+  updated directly in the commit handler (single-worker equivalence);
+- command payload presence is tracked by `status >= PAYLOAD` against the
+  engine's dense command table instead of shipping payload bytes.
+
+Message kinds/payloads (int32 rows):
+- MCOLLECT      [dot, clock, quorum_mask]
+- MCOLLECTACK   [dot, clock, (start,end) x KPC]
+- MCOMMIT       [dot, clock, (start,end) x KPC x n]   (voter-major per key)
+- MDETACHED     [key, start, end]                      (voter = src)
+- MCONSENSUS    [dot, ballot, clock]
+- MCONSENSUSACK [dot, ballot]
+- MGC           [frontier_0 .. frontier_{n-1}]
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..engine.types import (
+    ExecOut,
+    ProtocolDef,
+    bit,
+    empty_execout,
+    empty_outbox,
+    outbox_row,
+)
+from ..executors import table as table_executor
+from .common import gc as gc_mod
+from .common import synod as synod_mod
+
+MCOLLECT = 0
+MCOLLECTACK = 1
+MCOMMIT = 2
+MDETACHED = 3
+MCONSENSUS = 4
+MCONSENSUSACK = 5
+MGC = 6
+N_KINDS = 7
+
+# status (tempo.rs Status)
+START = 0
+PAYLOAD = 1
+COLLECT = 2
+COMMIT = 3
+
+
+class TempoState(NamedTuple):
+    clocks: jnp.ndarray  # [n, K] int32 per-key clock
+    status: jnp.ndarray  # [n, DOTS] int32
+    qmask: jnp.ndarray  # [n, DOTS] int32 fast quorum of the dot
+    qsize: jnp.ndarray  # [n, DOTS] int32 (NFR may shrink it per command)
+    # coordinator aggregation (QuorumClocks)
+    qc_count: jnp.ndarray  # [n, DOTS] int32 acks received
+    qc_max: jnp.ndarray  # [n, DOTS] int32 max clock reported
+    qc_maxcount: jnp.ndarray  # [n, DOTS] int32 reports of the max
+    # coordinator vote aggregation (TempoInfo::votes)
+    votes_s: jnp.ndarray  # [n, DOTS, KPC, n] int32 range start per (key, voter)
+    votes_e: jnp.ndarray  # [n, DOTS, KPC, n] int32
+    # buffered MCommit received before MCollect (tempo.rs:41-45)
+    bufc_valid: jnp.ndarray  # [n, DOTS] bool
+    bufc_clock: jnp.ndarray  # [n, DOTS] int32
+    bufc_s: jnp.ndarray  # [n, DOTS, KPC, n] int32
+    bufc_e: jnp.ndarray  # [n, DOTS, KPC, n] int32
+    synod: synod_mod.SynodState
+    max_commit_clock: jnp.ndarray  # [n] int32
+    gc: gc_mod.GCTrack
+    fast_count: jnp.ndarray  # [n] int32
+    slow_count: jnp.ndarray  # [n] int32
+    commit_count: jnp.ndarray  # [n] int32
+
+
+def make_protocol(
+    n: int,
+    keys_per_command: int = 1,
+    key_space_hint: int = 0,
+    nfr: bool = False,
+    clock_bump: bool = False,
+) -> ProtocolDef:
+    """Build the Tempo ProtocolDef.
+
+    `key_space_hint` is only needed when `clock_bump` is set (the ClockBump
+    periodic event iterates all keys, so its outbox is K rows wide).
+    """
+    KPC = keys_per_command
+    MSG_W = max(2 + 2 * KPC * n, n, 3)
+    MAX_OUT = 1 + KPC
+    MAX_EXEC = KPC
+    exdef = table_executor.make_executor(n)
+    EW = exdef.exec_width
+
+    def init(spec, env):
+        DOTS = spec.dots
+        K = spec.key_space
+        z = lambda *shape: jnp.zeros(shape, jnp.int32)
+        return TempoState(
+            clocks=z(n, K),
+            status=z(n, DOTS),
+            qmask=z(n, DOTS),
+            qsize=z(n, DOTS),
+            qc_count=z(n, DOTS),
+            qc_max=z(n, DOTS),
+            qc_maxcount=z(n, DOTS),
+            votes_s=z(n, DOTS, KPC, n),
+            votes_e=z(n, DOTS, KPC, n),
+            bufc_valid=jnp.zeros((n, DOTS), jnp.bool_),
+            bufc_clock=z(n, DOTS),
+            bufc_s=z(n, DOTS, KPC, n),
+            bufc_e=z(n, DOTS, KPC, n),
+            synod=synod_mod.synod_init(n, DOTS),
+            max_commit_clock=z(n),
+            gc=gc_mod.gc_init(n, DOTS),
+            fast_count=z(n),
+            slow_count=z(n),
+            commit_count=z(n),
+        )
+
+    # ------------------------------------------------------------------
+    # clock bumping / vote generation (common/table/clocks/keys)
+    # ------------------------------------------------------------------
+
+    def _vote_up_to(st: TempoState, p, keys, up_to, enable):
+        """Bump each key's clock to `up_to`, returning one vote range per key
+        slot (`sequential.rs:100-118` maybe_bump). Sequential over slots so
+        duplicate keys within a command vote once."""
+        clocks = st.clocks
+        ss, es = [], []
+        for i in range(KPC):
+            k = keys[i]
+            old = clocks[p, k]
+            votes = enable & (old < up_to)
+            ss.append(jnp.where(votes, old + 1, 0))
+            es.append(jnp.where(votes, up_to, 0))
+            clocks = clocks.at[p, k].set(jnp.where(votes, up_to, old))
+        return st._replace(clocks=clocks), jnp.stack(ss), jnp.stack(es)
+
+    def _proposal(ctx, st: TempoState, p, dot, min_clock, enable):
+        """KeyClocks::proposal — clock = max(min_clock, cur+1) (no bump for
+        NFR-allowed reads), votes = the bumped ranges per key."""
+        keys = ctx.cmds.keys[dot]
+        cur = jnp.int32(0)
+        for i in range(KPC):
+            cur = jnp.maximum(cur, st.clocks[p, keys[i]])
+        bump = jnp.int32(1)
+        if nfr and KPC == 1:
+            bump = jnp.where(ctx.cmds.read_only[dot], 0, 1)
+        clock = jnp.maximum(min_clock, cur + bump)
+        st, ss, es = _vote_up_to(st, p, keys, clock, enable)
+        return st, clock, ss, es
+
+    def _detached_rows(ctx, st: TempoState, ob, row0, p, dot, up_to, enable):
+        """Generate detached votes on the dot's keys up to `up_to` and emit
+        them eagerly as MDETACHED broadcast rows (see module docstring)."""
+        keys = ctx.cmds.keys[dot]
+        st, ss, es = _vote_up_to(st, p, keys, up_to, enable)
+        for i in range(KPC):
+            ob = outbox_row(
+                ob, row0 + i, ss[i] > 0, ctx.env.all_mask, MDETACHED,
+                [keys[i], ss[i], es[i]],
+            )
+        return st, ob
+
+    # ------------------------------------------------------------------
+    # commit path (tempo.rs:575-674)
+    # ------------------------------------------------------------------
+
+    def _commit(ctx, st: TempoState, ob, row0, p, dot, clock, rs, re, enable):
+        """Shared commit path: mark COMMIT, emit attached-vote execution
+        infos, bump `max_commit_clock`, generate detached votes, track GC."""
+        st = st._replace(
+            status=st.status.at[p, dot].set(
+                jnp.where(enable, COMMIT, st.status[p, dot])
+            ),
+            max_commit_clock=st.max_commit_clock.at[p].max(
+                jnp.where(enable, clock, 0)
+            ),
+            synod=st.synod._replace(
+                acc_val=st.synod.acc_val.at[p, dot].set(
+                    jnp.where(enable, clock, st.synod.acc_val[p, dot])
+                )
+            ),
+            commit_count=st.commit_count.at[p].add(enable.astype(jnp.int32)),
+            gc=gc_mod.gc_commit(st.gc, p, dot, enable, ctx.spec.max_seq),
+        )
+        # attached votes -> executor, one row per key slot
+        info_rows = []
+        for k in range(KPC):
+            row = [jnp.int32(table_executor.ATTACHED), jnp.int32(k), dot, clock]
+            for v in range(n):
+                row += [rs[k, v], re[k, v]]
+            info_rows.append(jnp.stack([jnp.asarray(x, jnp.int32) for x in row]))
+        execout = ExecOut(
+            valid=jnp.broadcast_to(enable, (MAX_EXEC,)),
+            info=jnp.stack(info_rows),
+        )
+        # detached votes up to the commit clock (tempo.rs:645-656); with
+        # real-time clock bumping this is left to the periodic event
+        if not clock_bump:
+            st, ob = _detached_rows(ctx, st, ob, row0, p, dot, clock, enable)
+        return st, ob, execout
+
+    # ------------------------------------------------------------------
+    # handlers
+    # ------------------------------------------------------------------
+
+    def submit(ctx, st: TempoState, p, dot, now):
+        st, clock, ss, es = _proposal(ctx, st, p, dot, jnp.int32(0), jnp.bool_(True))
+        # store coordinator votes for later aggregation (tempo.rs:297-310)
+        st = st._replace(
+            votes_s=st.votes_s.at[p, dot, :, p].set(ss),
+            votes_e=st.votes_e.at[p, dot, :, p].set(es),
+        )
+        # NFR single-key reads use a plain majority as the fast quorum
+        # (BaseProcess::maybe_adjust_fast_quorum)
+        if nfr and KPC == 1:
+            qmask = jnp.where(
+                ctx.cmds.read_only[dot], ctx.env.maj_mask[p], ctx.env.fq_mask[p]
+            )
+        else:
+            qmask = ctx.env.fq_mask[p]
+        ob = outbox_row(
+            empty_outbox(MAX_OUT, MSG_W), 0,
+            jnp.bool_(True), ctx.env.all_mask, MCOLLECT, [dot, clock, qmask],
+        )
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def h_mcollect(ctx, st: TempoState, p, src, payload, now):
+        dot, rclock, qmask = payload[0], payload[1], payload[2]
+        is_start = st.status[p, dot] == START
+        in_q = bit(qmask, p) == 1
+        from_self = src == p
+
+        # fast-quorum member: own proposal with the remote clock as minimum;
+        # from self: keep the already-computed clock and votes (tempo.rs:389-427)
+        q_en = is_start & in_q
+        st, pclk, ss, es = _proposal(ctx, st, p, dot, rclock, q_en & ~from_self)
+        clk = jnp.where(from_self, rclock, pclk)
+        ss = jnp.where(from_self, 0, ss)
+        es = jnp.where(from_self, 0, es)
+        qsz = jnp.zeros((), jnp.int32)
+        for i in range(n):
+            qsz = qsz + bit(qmask, jnp.int32(i))
+        st = st._replace(
+            status=st.status.at[p, dot].set(
+                jnp.where(
+                    is_start,
+                    jnp.where(in_q, COLLECT, PAYLOAD),
+                    st.status[p, dot],
+                )
+            ),
+            qmask=st.qmask.at[p, dot].set(jnp.where(q_en, qmask, st.qmask[p, dot])),
+            qsize=st.qsize.at[p, dot].set(jnp.where(q_en, qsz, st.qsize[p, dot])),
+            synod=synod_mod.set_if_not_accepted(st.synod, p, dot, clk, q_en),
+        )
+        ack_payload = [dot, clk]
+        for i in range(KPC):
+            ack_payload += [ss[i], es[i]]
+        ob = outbox_row(
+            empty_outbox(MAX_OUT, MSG_W), 0,
+            q_en, jnp.int32(1) << src, MCOLLECTACK, ack_payload,
+        )
+        # non-quorum member: payload only; flush a buffered commit if the
+        # MCommit overtook the MCollect (tempo.rs:369-387)
+        flush = is_start & ~in_q & st.bufc_valid[p, dot]
+        st = st._replace(bufc_valid=st.bufc_valid.at[p, dot].set(
+            st.bufc_valid[p, dot] & ~flush
+        ))
+        st, ob, execout = _commit(
+            ctx, st, ob, 1, p, dot,
+            st.bufc_clock[p, dot], st.bufc_s[p, dot], st.bufc_e[p, dot], flush,
+        )
+        return st, ob, execout
+
+    def h_mcollectack(ctx, st: TempoState, p, src, payload, now):
+        dot, clk = payload[0], payload[1]
+        collect = st.status[p, dot] == COLLECT
+
+        # merge remote votes (tempo.rs:493-495)
+        votes_s, votes_e = st.votes_s, st.votes_e
+        for i in range(KPC):
+            s_i, e_i = payload[2 + 2 * i], payload[3 + 2 * i]
+            take = collect & (s_i > 0)
+            votes_s = votes_s.at[p, dot, i, src].set(
+                jnp.where(take, s_i, votes_s[p, dot, i, src])
+            )
+            votes_e = votes_e.at[p, dot, i, src].set(
+                jnp.where(take, e_i, votes_e[p, dot, i, src])
+            )
+
+        # QuorumClocks::add (quorum.rs:36-60)
+        old_max, old_cnt = st.qc_max[p, dot], st.qc_maxcount[p, dot]
+        new_max = jnp.maximum(old_max, clk)
+        new_cnt = jnp.where(clk > old_max, 1, jnp.where(clk == old_max, old_cnt + 1, old_cnt))
+        count = st.qc_count[p, dot] + collect.astype(jnp.int32)
+        st = st._replace(
+            votes_s=votes_s,
+            votes_e=votes_e,
+            qc_count=st.qc_count.at[p, dot].set(count),
+            qc_max=st.qc_max.at[p, dot].set(jnp.where(collect, new_max, old_max)),
+            qc_maxcount=st.qc_maxcount.at[p, dot].set(
+                jnp.where(collect, new_cnt, old_cnt)
+            ),
+        )
+
+        ob = empty_outbox(MAX_OUT, MSG_W)
+        # optimization: bump own keys to the quorum max (tempo.rs:505-521)
+        st, ob = _detached_rows(
+            ctx, st, ob, 1, p, dot, new_max, collect & (src != p)
+        )
+
+        # all fast-quorum clocks in? (tempo.rs:524-570)
+        all_in = collect & (count == st.qsize[p, dot])
+        minority = n // 2
+        threshold = st.qsize[p, dot] - minority
+        fast = all_in & (new_cnt >= threshold)
+        slow = all_in & ~(new_cnt >= threshold)
+
+        # fast path: MCommit with the aggregated votes
+        commit_payload = [dot, new_max]
+        for k in range(KPC):
+            for v in range(n):
+                commit_payload += [votes_s[p, dot, k, v], votes_e[p, dot, k, v]]
+        # slow path: synod with skipped prepare (ballot = 1-based own id)
+        st = st._replace(
+            synod=synod_mod.skip_prepare(st.synod, p, dot, new_max, slow),
+            fast_count=st.fast_count.at[p].add(fast.astype(jnp.int32)),
+            slow_count=st.slow_count.at[p].add(slow.astype(jnp.int32)),
+        )
+        row_kind = jnp.where(fast, MCOMMIT, MCONSENSUS)
+        row_tgt = jnp.where(fast, ctx.env.all_mask, ctx.env.wq_mask[p])
+        cons_payload = [dot, p + 1, new_max]
+        width = max(len(commit_payload), len(cons_payload))
+        pay = jnp.where(
+            fast,
+            jnp.stack([jnp.asarray(x, jnp.int32) for x in commit_payload + [jnp.int32(0)] * (width - len(commit_payload))]),
+            jnp.stack([jnp.asarray(x, jnp.int32) for x in cons_payload + [jnp.int32(0)] * (width - len(cons_payload))]),
+        )
+        ob = outbox_row(ob, 0, all_in, row_tgt, row_kind, list(pay))
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def h_mcommit(ctx, st: TempoState, p, src, payload, now):
+        dot, clock = payload[0], payload[1]
+        rs = payload[2 : 2 + 2 * KPC * n : 2].reshape(KPC, n)
+        re = payload[3 : 3 + 2 * KPC * n : 2].reshape(KPC, n)
+        is_start = st.status[p, dot] == START
+        can_commit = (st.status[p, dot] == PAYLOAD) | (st.status[p, dot] == COLLECT)
+
+        # MCommit before MCollect: buffer it (tempo.rs:594-599)
+        st = st._replace(
+            bufc_valid=st.bufc_valid.at[p, dot].set(
+                st.bufc_valid[p, dot] | is_start
+            ),
+            bufc_clock=st.bufc_clock.at[p, dot].set(
+                jnp.where(is_start, clock, st.bufc_clock[p, dot])
+            ),
+            bufc_s=st.bufc_s.at[p, dot].set(
+                jnp.where(is_start, rs, st.bufc_s[p, dot])
+            ),
+            bufc_e=st.bufc_e.at[p, dot].set(
+                jnp.where(is_start, re, st.bufc_e[p, dot])
+            ),
+        )
+        ob = empty_outbox(MAX_OUT, MSG_W)
+        st, ob, execout = _commit(ctx, st, ob, 0, p, dot, clock, rs, re, can_commit)
+        return st, ob, execout
+
+    def h_mdetached(ctx, st: TempoState, p, src, payload, now):
+        key, s, e = payload[0], payload[1], payload[2]
+        execout = empty_execout(MAX_EXEC, EW)
+        row = jnp.zeros((EW,), jnp.int32)
+        row = row.at[0].set(table_executor.DETACHED)
+        row = row.at[1].set(key).at[2].set(src).at[3].set(s).at[4].set(e)
+        execout = execout._replace(
+            valid=execout.valid.at[0].set(True),
+            info=execout.info.at[0].set(row),
+        )
+        return st, empty_outbox(MAX_OUT, MSG_W), execout
+
+    def h_mconsensus(ctx, st: TempoState, p, src, payload, now):
+        dot, ballot, clock = payload[0], payload[1], payload[2]
+        chosen = st.status[p, dot] == COMMIT
+        ob = empty_outbox(MAX_OUT, MSG_W)
+        # detached votes up to the consensus clock if we have the payload
+        # (tempo.rs:756-761)
+        st, ob = _detached_rows(
+            ctx, st, ob, 1, p, dot, clock,
+            ~chosen & (st.status[p, dot] != START),
+        )
+        sy, accepted = synod_mod.handle_accept(st.synod, p, dot, ballot, clock)
+        st = st._replace(
+            synod=jax.tree_util.tree_map(
+                lambda a, b: jnp.where(chosen, a, b), st.synod, sy
+            )
+        )
+        # already chosen: reply MCommit with the stored votes (tempo.rs:780-786);
+        # otherwise ack the accept
+        commit_payload = [dot, st.synod.acc_val[p, dot]]
+        for k in range(KPC):
+            for v in range(n):
+                commit_payload += [st.votes_s[p, dot, k, v], st.votes_e[p, dot, k, v]]
+        ack_payload = [dot, ballot] + [jnp.int32(0)] * (len(commit_payload) - 2)
+        pay = jnp.where(
+            chosen,
+            jnp.stack([jnp.asarray(x, jnp.int32) for x in commit_payload]),
+            jnp.stack([jnp.asarray(x, jnp.int32) for x in ack_payload]),
+        )
+        ob = outbox_row(
+            ob, 0,
+            chosen | accepted,
+            jnp.int32(1) << src,
+            jnp.where(chosen, MCOMMIT, MCONSENSUSACK),
+            list(pay),
+        )
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def h_mconsensusack(ctx, st: TempoState, p, src, payload, now):
+        dot, ballot = payload[0], payload[1]
+        not_committed = st.status[p, dot] != COMMIT
+        sy, chosen, value = synod_mod.handle_accepted(
+            st.synod, p, dot, ballot, ctx.env.wq_size
+        )
+        chosen = chosen & not_committed
+        st = st._replace(synod=sy)
+        commit_payload = [dot, value]
+        for k in range(KPC):
+            for v in range(n):
+                commit_payload += [st.votes_s[p, dot, k, v], st.votes_e[p, dot, k, v]]
+        ob = outbox_row(
+            empty_outbox(MAX_OUT, MSG_W), 0,
+            chosen, ctx.env.all_mask, MCOMMIT, commit_payload,
+        )
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def h_mgc(ctx, st: TempoState, p, src, payload, now):
+        st = st._replace(gc=gc_mod.gc_handle_mgc(st.gc, p, src, payload[:n]))
+        return st, empty_outbox(MAX_OUT, MSG_W), empty_execout(MAX_EXEC, EW)
+
+    def handle(ctx, st, p, src, kind, payload, now):
+        branches = [
+            functools.partial(h, ctx)
+            for h in (
+                h_mcollect,
+                h_mcollectack,
+                h_mcommit,
+                h_mdetached,
+                h_mconsensus,
+                h_mconsensusack,
+                h_mgc,
+            )
+        ]
+        return jax.lax.switch(kind, branches, st, p, src, payload, now)
+
+    # ------------------------------------------------------------------
+    # periodic events
+    # ------------------------------------------------------------------
+
+    def periodic(ctx, st: TempoState, p, kind, now):
+        if kind == 0:
+            # GarbageCollection (tempo.rs:973-988)
+            all_but_me = ctx.env.all_mask & ~(jnp.int32(1) << p)
+            row = gc_mod.gc_frontier_row(st.gc, p)
+            ob = outbox_row(
+                empty_outbox(1, MSG_W), 0,
+                jnp.bool_(True), all_but_me, MGC, [row[a] for a in range(n)],
+            )
+            return st, ob
+        # ClockBump (tempo.rs:991-1010): bump every key to
+        # max(max_commit_clock, now in micros), emitting detached votes
+        K = key_space_hint
+        assert K > 0, "clock_bump needs key_space_hint"
+        up_to = jnp.maximum(st.max_commit_clock[p], now * 1000)
+        ob = empty_outbox(K, MSG_W)
+        clocks = st.clocks
+        for k in range(K):
+            old = clocks[p, k]
+            votes = old < up_to
+            ob = outbox_row(
+                ob, k, votes, ctx.env.all_mask, MDETACHED, [jnp.int32(k), old + 1, up_to]
+            )
+            clocks = clocks.at[p, k].set(jnp.maximum(old, up_to))
+        return st._replace(clocks=clocks), ob
+
+    def metrics(st: TempoState):
+        return {
+            "stable": st.gc.stable_count,
+            "commits": st.commit_count,
+            "fast": st.fast_count,
+            "slow": st.slow_count,
+        }
+
+    periodic_events = [("garbage_collection", lambda cfg: cfg.gc_interval_ms)]
+    if clock_bump:
+        periodic_events.append(
+            ("clock_bump", lambda cfg: cfg.tempo_clock_bump_interval_ms)
+        )
+
+    return ProtocolDef(
+        name="tempo",
+        n_msg_kinds=N_KINDS,
+        msg_width=MSG_W,
+        max_out=MAX_OUT,
+        max_exec=MAX_EXEC,
+        executor=exdef,
+        init=init,
+        submit=submit,
+        handle=handle,
+        periodic_events=tuple(periodic_events),
+        periodic=periodic,
+        quorum_sizes=lambda cfg: cfg.tempo_quorum_sizes(),
+        leaderless=True,
+        metrics=metrics,
+    )
