@@ -16,7 +16,7 @@ from typing import NamedTuple
 import jax.numpy as jnp
 
 from ..engine.types import ExecutorDef
-from .ready import ReadyRing, ready_capacity, ready_drain, ready_init, ready_push, writer_id
+from .ready import ReadyRing, ready_drain, ready_init, ready_push, writer_id
 
 EXEC_WIDTH = 3
 

@@ -1,0 +1,161 @@
+"""Graph executor: dependency-graph SCC ordering (Atlas / EPaxos / Janus).
+
+Reference parity: `fantoch_ps/src/executor/graph/` — committed commands carry
+a set of dependencies (dots); a command executes when its strongly-connected
+component is *ready*: every dependency path out of the SCC reaches only
+executed commands, and all SCC members are committed. SCCs execute in reverse
+topological order; members of an SCC execute in dot order
+(`graph/tarjan.rs:14-15` SCC = BTreeSet<Dot>; `strong_connect:96-200`).
+Commands whose exploration hits an uncommitted dependency park in a pending
+index and are retried when that dependency commits
+(`graph/mod.rs:46-120` vertex/pending indexes, `executed_clock`).
+
+TPU-native redesign — *no recursive Tarjan*. The recursion is replaced by a
+transitive closure over the committed-but-unexecuted window, computed with
+boolean matrix squaring (int matmuls — MXU-shaped on TPU):
+
+- `V`       = committed & ~executed vertices;
+- `bad(d)`  = some dependency of `d` is neither committed nor executed;
+- `R*`      = transitive closure of the dependency edges restricted to `V`
+              (log2(DOTS) squarings);
+- `blocked` = bad | reaches-bad through `R*`; the unblocked set `U = V &
+              ~blocked` is exactly the union of all ready SCCs (its downward
+              closure is committed);
+- order     = ascending `(rank, dot)` where `rank(u) = |reach(u) ∪ {u}|
+              within U`: two commands in the same SCC have equal rank (tie-broken
+              by dot, the reference's in-SCC order); across comparable SCCs
+              the dependency-wise earlier SCC has strictly smaller rank, so
+              it executes first; equal-rank distinct SCCs are incomparable,
+              hence non-conflicting, and any interleaving is equivalent.
+
+Execution-info row (width 1 + MAX_DEPS): ``[dot, dep_0+1 .. dep_D+1]``
+(0 = empty slot) — `GraphExecutionInfo::Add` (`graph/executor.rs:198`).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..engine.types import ExecutorDef
+from .ready import ReadyRing, ready_capacity, ready_drain, ready_init, ready_push, writer_id
+
+ORDER_HASH_MULT = jnp.int32(0x01000193)
+
+
+class GraphExecState(NamedTuple):
+    kvs: jnp.ndarray  # [n, K] int32
+    committed: jnp.ndarray  # [n, DOTS] bool vertex present
+    executed: jnp.ndarray  # [n, DOTS] bool
+    deps: jnp.ndarray  # [n, DOTS, D] int32 flat dot + 1 (0 = empty)
+    order_hash: jnp.ndarray  # [n, K] int32
+    order_cnt: jnp.ndarray  # [n, K] int32
+    executed_count: jnp.ndarray  # [n] int32 commands executed
+    chain_max: jnp.ndarray  # [n] int32 largest ready batch (ChainSize metric)
+    ready: ReadyRing
+
+
+def make_executor(n: int, max_deps: int) -> ExecutorDef:
+    D = max_deps
+    EW = 1 + D
+
+    def init(spec, env):
+        DOTS = spec.dots
+        return GraphExecState(
+            kvs=jnp.zeros((n, spec.key_space), jnp.int32),
+            committed=jnp.zeros((n, DOTS), jnp.bool_),
+            executed=jnp.zeros((n, DOTS), jnp.bool_),
+            deps=jnp.zeros((n, DOTS, D), jnp.int32),
+            order_hash=jnp.zeros((n, spec.key_space), jnp.int32),
+            order_cnt=jnp.zeros((n, spec.key_space), jnp.int32),
+            executed_count=jnp.zeros((n,), jnp.int32),
+            chain_max=jnp.zeros((n,), jnp.int32),
+            ready=ready_init(n, ready_capacity(spec)),
+        )
+
+    def _try_execute(ctx, est: GraphExecState, p):
+        DOTS = est.committed.shape[1]
+        KPC = ctx.spec.keys_per_command
+        dots = jnp.arange(DOTS, dtype=jnp.int32)
+
+        V = est.committed[p] & ~est.executed[p]  # [DOTS]
+        dep = est.deps[p]  # [DOTS, D]
+        has_dep = dep > 0
+        tgt = jnp.clip(dep - 1, 0, DOTS - 1)  # [DOTS, D]
+        dep_known = est.committed[p][tgt] | est.executed[p][tgt]
+        bad = (has_dep & ~dep_known).any(axis=1) & V  # [DOTS]
+
+        # adjacency restricted to V (edges to executed vertices are satisfied)
+        A = jnp.zeros((DOTS, DOTS), jnp.bool_)
+        for j in range(D):
+            edge = V & has_dep[:, j] & V[tgt[:, j]]
+            A = A.at[dots, tgt[:, j]].max(edge)
+
+        # transitive closure by boolean matrix squaring
+        def square(_, C):
+            Ci = C.astype(jnp.int32)
+            return C | ((Ci @ Ci) > 0)
+
+        steps = max(1, (DOTS - 1).bit_length())
+        R = jax.lax.fori_loop(0, steps, square, A)
+
+        blocked = bad | (R & bad[None, :]).any(axis=1)
+        U = V & ~blocked
+        # rank = |reach(u) ∪ {u}| within U: strictly larger for the
+        # dependency-wise later of two comparable SCCs even when the later one
+        # is a singleton absorbed into its dependency's reach set
+        Rs = R | jnp.eye(DOTS, dtype=jnp.bool_)
+        rank = (Rs & U[None, :]).sum(axis=1)
+        est = est._replace(chain_max=est.chain_max.at[p].max(U.sum()))
+
+        def cond(carry):
+            e, u = carry
+            return u.any()
+
+        def body(carry):
+            e, u = carry
+            r = jnp.where(u, rank, jnp.int32(2**30))
+            rmin = r.min()
+            d = jnp.where(r == rmin, dots, jnp.int32(2**30)).min()
+            client = ctx.cmds.client[d]
+            rifl = ctx.cmds.rifl_seq[d]
+            kvs, oh, oc, ready = e.kvs, e.order_hash, e.order_cnt, e.ready
+            for k in range(KPC):
+                key = ctx.cmds.keys[d, k]
+                kvs = kvs.at[p, key].set(writer_id(client, rifl))
+                oh = oh.at[p, key].set(oh[p, key] * ORDER_HASH_MULT + (d + 1))
+                oc = oc.at[p, key].add(1)
+                ready = ready_push(ready, p, client, rifl)
+            e = e._replace(
+                kvs=kvs,
+                order_hash=oh,
+                order_cnt=oc,
+                ready=ready,
+                executed=e.executed.at[p, d].set(True),
+                executed_count=e.executed_count.at[p].add(1),
+            )
+            return e, u.at[d].set(False)
+
+        est, _ = jax.lax.while_loop(cond, body, (est, U))
+        return est
+
+    def handle(ctx, est: GraphExecState, p, info, now):
+        dot = info[0]
+        est = est._replace(
+            committed=est.committed.at[p, dot].set(True),
+            deps=est.deps.at[p, dot].set(info[1 : 1 + D]),
+        )
+        return _try_execute(ctx, est, p)
+
+    def drain(ctx, est: GraphExecState, p):
+        ready, res = ready_drain(est.ready, p, ctx.spec.max_res)
+        return est._replace(ready=ready), res
+
+    return ExecutorDef(
+        name="graph",
+        exec_width=EW,
+        init=init,
+        handle=handle,
+        drain=drain,
+    )

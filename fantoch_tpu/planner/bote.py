@@ -1,0 +1,332 @@
+"""Bote: closed-form latency planner (the `fantoch_bote` equivalent).
+
+Reference parity: `fantoch_bote/src/lib.rs` — client-perceived commit latency
+without simulation, from ping matrices and quorum sizes:
+
+- ``leaderless``: client → closest config region → that region's
+  `quorum_size`-th closest config region (itself counts, at 0 ms)
+  (`lib.rs:38-58`);
+- ``leader``: client → leader → leader's quorum (`lib.rs:60-88`);
+- ``best_leader``: the config region minimizing a Histogram stat of the
+  per-client latencies (`lib.rs:90-118`); the search pins FPaxos' leader to
+  the best-COV f=1 leader (`search.rs:262-276`);
+- protocol quorum sizes (`protocol.rs:20-35`): FPaxos f+1, EPaxos
+  f+⌈(f+1)/2⌉ with f=⌊n/2⌋, Atlas ⌊n/2⌋+f.
+
+TPU-native redesign: instead of rayon over region combinations
+(`search.rs:208-231`), every candidate configuration is a boolean membership
+row over the region universe and the whole grid evaluates as one vmapped
+closed-form expression on device — `batch_latencies` is `[B, C]` for B
+configs in a single `jit`. Ties in "closest" follow the reference's
+`(latency, region-name)` order (`planet/mod.rs:121-139`): callers pass the
+region universe sorted by name so a stable argsort reproduces it.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.metrics import Histogram
+from ..core.planet import Planet
+
+INF = jnp.int32(2**30)
+
+FPAXOS = "fpaxos"
+EPAXOS = "epaxos"
+ATLAS = "atlas"
+
+
+def quorum_size(protocol: str, n: int, f: int) -> int:
+    """Planner quorum sizes (`fantoch_bote/src/protocol.rs:20-35`)."""
+    minority = n // 2
+    if protocol == FPAXOS:
+        return f + 1
+    if protocol == EPAXOS:
+        fm = minority
+        return fm + (fm + 1) // 2
+    if protocol == ATLAS:
+        return minority + f
+    raise ValueError(protocol)
+
+
+# ----------------------------------------------------------------------
+# device kernels (region universe axis R; config = bool membership row)
+# ----------------------------------------------------------------------
+
+
+def _masked_sorted_row(ping_row, mask):
+    """Latencies from one region to the config's regions, ascending, ties by
+    region index (the name order of the universe)."""
+    masked = jnp.where(mask, ping_row, INF)
+    return jnp.sort(masked, stable=True)
+
+
+def _nth_closest_lat(ping_row, mask, nth):
+    """Latency to the nth (1-based) closest config region."""
+    return _masked_sorted_row(ping_row, mask)[nth - 1]
+
+
+def leaderless_latencies(ping, mask, client_idx, q):
+    """[C] client-perceived latency for a leaderless protocol (`lib.rs:38-58`).
+
+    `ping`: [R, R] int32, `mask`: [R] bool config membership,
+    `client_idx`: [C] int32 region index per client, `q`: quorum size.
+    """
+    R = ping.shape[0]
+
+    def per_client(c):
+        row = ping[c]
+        masked = jnp.where(mask, row, INF)
+        # closest config region, ties by region index (stable)
+        closest = jnp.argmin(masked)
+        to_closest = masked[closest]
+        quorum_lat = _nth_closest_lat(ping[closest], mask, q)
+        return to_closest + quorum_lat
+
+    return jax.vmap(per_client)(client_idx)
+
+
+def leader_latencies(ping, mask, client_idx, leader, q):
+    """[C] client-perceived latency through a fixed leader (`lib.rs:60-88`)."""
+    quorum_lat = _nth_closest_lat(ping[leader], mask, q)
+    return ping[client_idx, leader] + quorum_lat
+
+
+def _stats(lat):
+    """(mean, cov, mdtm) of an int latency vector, reference Histogram defs."""
+    lat = lat.astype(jnp.float32)
+    c = lat.shape[0]
+    mean = lat.mean()
+    var = jnp.where(c > 1, ((lat - mean) ** 2).sum() / jnp.maximum(c - 1, 1), jnp.nan)
+    cov = jnp.sqrt(var) / mean
+    mdtm = jnp.abs(lat - mean).mean()
+    return mean, cov, mdtm
+
+
+def best_leader_latencies(ping, mask, client_idx, q, sort_by: str = "cov"):
+    """Latencies through the best config leader (`lib.rs:90-118`): evaluate
+    every config region as leader, keep the one with the lowest stat (ties by
+    region index, matching the reference's stable sort)."""
+    R = ping.shape[0]
+
+    def per_leader(leader):
+        lat = leader_latencies(ping, mask, client_idx, leader, q)
+        mean, cov, mdtm = _stats(lat)
+        stat = {"mean": mean, "cov": cov, "mdtm": mdtm}[sort_by]
+        return jnp.where(mask[leader], stat, jnp.float32(jnp.inf)), lat
+
+    stats, lats = jax.vmap(per_leader)(jnp.arange(R))
+    best = jnp.argmin(stats)
+    return best, lats[best]
+
+
+# ----------------------------------------------------------------------
+# host API
+# ----------------------------------------------------------------------
+
+
+class Bote:
+    """Closed-form planner over a Planet (`fantoch_bote/src/lib.rs:17-30`)."""
+
+    def __init__(self, planet: Optional[Planet] = None, regions: Optional[Sequence[str]] = None):
+        self.planet = planet or Planet.new()
+        # universe sorted by name so stable sorts reproduce the reference's
+        # (latency, region-name) tie-break
+        self.regions = sorted(regions or self.planet.regions())
+        self.index = {r: i for i, r in enumerate(self.regions)}
+        self.ping = jnp.asarray(self.planet.ping_matrix_ms(self.regions))
+
+    def _mask(self, servers: Sequence[str]) -> jnp.ndarray:
+        m = np.zeros((len(self.regions),), bool)
+        for r in servers:
+            m[self.index[r]] = True
+        return jnp.asarray(m)
+
+    def _clients(self, clients: Sequence[str]) -> jnp.ndarray:
+        return jnp.asarray([self.index[c] for c in clients], jnp.int32)
+
+    def leaderless(self, servers, clients, q) -> List[Tuple[str, int]]:
+        lat = leaderless_latencies(self.ping, self._mask(servers), self._clients(clients), q)
+        return list(zip(clients, np.asarray(lat).tolist()))
+
+    def leader(self, leader: str, servers, clients, q) -> List[Tuple[str, int]]:
+        lat = leader_latencies(
+            self.ping, self._mask(servers), self._clients(clients), self.index[leader], q
+        )
+        return list(zip(clients, np.asarray(lat).tolist()))
+
+    def best_leader(self, servers, clients, q, sort_by: str = "cov") -> Tuple[str, Histogram]:
+        best, lat = best_leader_latencies(
+            self.ping, self._mask(servers), self._clients(clients), q, sort_by
+        )
+        return self.regions[int(best)], Histogram.from_values(np.asarray(lat).tolist())
+
+    def quorum_latency(self, from_region: str, servers, q) -> int:
+        return int(
+            _nth_closest_lat(self.ping[self.index[from_region]], self._mask(servers), q)
+        )
+
+
+# ----------------------------------------------------------------------
+# search over region subsets (`fantoch_bote/src/search.rs`)
+# ----------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class RankingParams:
+    """`search.rs:617-648` — improvement thresholds are in ms (latency) or
+    percentage points (fairness/decrease), compared as mean differences."""
+
+    min_mean_fpaxos_improv: float
+    min_mean_epaxos_improv: float
+    min_fairness_fpaxos_improv: float
+    min_mean_decrease: float
+    min_n: int = 3
+    max_n: int = 13
+    ft_metric: str = "f1f2"  # "f1" | "f1f2" (`search.rs:652-666`)
+
+    def fs(self, n: int) -> List[int]:
+        max_f = 1 if self.ft_metric == "f1" else 2
+        return list(range(1, min(n // 2, max_f) + 1))
+
+
+class Search:
+    """Exhaustive scoring of every size-n region subset, vmapped on device.
+
+    The reference enumerates combinations with `permutator` and scores them
+    with rayon (`search.rs:233-259`); here the combination list becomes a
+    `[B, R]` mask tensor and one jitted vmap scores the whole batch:
+    per config we keep, for each (protocol, f), the mean/cov of the
+    client-perceived latencies (`compute_stats`, `search.rs:262-317`).
+    """
+
+    def __init__(self, bote: Bote, ns: Sequence[int], clients: Sequence[str]):
+        self.bote = bote
+        self.ns = list(ns)
+        self.clients = list(clients)
+        self.configs: Dict[int, np.ndarray] = {}  # n -> [B, R] bool
+        self.stats: Dict[int, Dict[str, np.ndarray]] = {}  # n -> key -> [B]
+        cidx = bote._clients(self.clients)
+
+        @jax.jit
+        def score_batch(masks, q_atlas_by_f, q_fpaxos_by_f, q_epaxos):
+            def one(mask):
+                out = []
+                # FPaxos leader fixed to the best-COV f=1 leader (search.rs:269-276)
+                leader, _ = best_leader_latencies(
+                    self.bote.ping, mask, cidx, q_fpaxos_by_f[0], "cov"
+                )
+                for qa in q_atlas_by_f:
+                    lat = leaderless_latencies(self.bote.ping, mask, cidx, qa)
+                    out.append(jnp.stack(_stats(lat)))
+                for qf in q_fpaxos_by_f:
+                    lat = leader_latencies(self.bote.ping, mask, cidx, leader, qf)
+                    out.append(jnp.stack(_stats(lat)))
+                lat = leaderless_latencies(self.bote.ping, mask, cidx, q_epaxos)
+                out.append(jnp.stack(_stats(lat)))
+                return jnp.stack(out)  # [2*F + 1, 3]
+
+            return jax.vmap(one)(masks)
+
+        self._score_batch = score_batch
+
+    @staticmethod
+    def max_f(n: int) -> int:
+        return min(n // 2, 2)  # `search.rs:473-476`
+
+    def compute(self) -> None:
+        R = len(self.bote.regions)
+        for n in self.ns:
+            combos = list(itertools.combinations(range(R), n))
+            masks = np.zeros((len(combos), R), bool)
+            for b, combo in enumerate(combos):
+                masks[b, list(combo)] = True
+            fs = list(range(1, self.max_f(n) + 1))
+            q_atlas = [quorum_size(ATLAS, n, f) for f in fs]
+            q_fpaxos = [quorum_size(FPAXOS, n, f) for f in fs]
+            res = np.asarray(
+                self._score_batch(
+                    jnp.asarray(masks),
+                    tuple(q_atlas),
+                    tuple(q_fpaxos),
+                    quorum_size(EPAXOS, n, 0),
+                )
+            )  # [B, 2F+1, 3]
+            stats: Dict[str, np.ndarray] = {}
+            for i, f in enumerate(fs):
+                stats[f"atlas_f{f}"] = res[:, i]
+            for i, f in enumerate(fs):
+                stats[f"fpaxos_f{f}"] = res[:, len(fs) + i]
+            stats["epaxos"] = res[:, 2 * len(fs)]
+            self.configs[n] = masks
+            self.stats[n] = stats
+
+    def rank(self, n: int, params: RankingParams) -> List[Tuple[float, int]]:
+        """(score, config index) for every valid config of size n, best first
+        (`search.rs:420-471` compute_score)."""
+        stats = self.stats[n]
+        B = self.configs[n].shape[0]
+        valid = np.ones((B,), bool)
+        score = np.zeros((B,))
+        for f in params.fs(n):
+            atlas_mean = stats[f"atlas_f{f}"][:, 0]
+            fpaxos_mean = stats[f"fpaxos_f{f}"][:, 0]
+            atlas_cov = stats[f"atlas_f{f}"][:, 1]
+            fpaxos_cov = stats[f"fpaxos_f{f}"][:, 1]
+            epaxos_mean = stats["epaxos"][:, 0]
+            fpaxos_improv = fpaxos_mean - atlas_mean
+            fairness_improv = (fpaxos_cov - atlas_cov) * 100.0
+            epaxos_improv = epaxos_mean - atlas_mean
+            valid &= fpaxos_improv >= params.min_mean_fpaxos_improv
+            valid &= fairness_improv >= params.min_fairness_fpaxos_improv
+            if n >= 11:
+                valid &= epaxos_improv >= params.min_mean_epaxos_improv
+            score += fpaxos_improv + 30.0 * epaxos_improv
+        idx = np.nonzero(valid)[0]
+        ranked = sorted(((float(score[i]), int(i)) for i in idx), reverse=True)
+        return ranked
+
+    def sorted_evolving_configs(
+        self, params: RankingParams, top: int = 100
+    ) -> List[Tuple[float, List[np.ndarray]]]:
+        """Chains of superset configs across the n ladder with enough mean
+        decrease at each growth step (`search.rs:99-176,374-418`)."""
+        ranked = {n: self.rank(n, params) for n in self.ns}
+        chains: List[Tuple[float, List[int]]] = []
+
+        def extend(chain_score, chain, ladder):
+            if not ladder:
+                chains.append((chain_score, list(chain)))
+                return
+            n = ladder[0]
+            prev_n = self.ns[self.ns.index(n) - 1]
+            prev_mask = self.configs[prev_n][chain[-1]]
+            prev_stats = self.stats[prev_n]
+            for score, i in ranked[n]:
+                mask = self.configs[n][i]
+                if not (mask & prev_mask).sum() == prev_mask.sum():
+                    continue  # not a superset
+                # min mean decrease for Atlas at the previous size's fs
+                ok = True
+                for f in params.fs(prev_n):
+                    dec = (
+                        prev_stats[f"atlas_f{f}"][chain[-1], 0]
+                        - self.stats[n][f"atlas_f{f}"][i, 0]
+                    )
+                    ok &= dec >= params.min_mean_decrease
+                if ok:
+                    extend(chain_score + score, chain + [i], ladder[1:])
+
+        first_n = self.ns[0]
+        for score, i in ranked[first_n]:
+            extend(score, [i], self.ns[1:])
+        chains.sort(key=lambda t: -t[0])
+        return [
+            (s, [self.configs[n][i] for n, i in zip(self.ns, chain)])
+            for s, chain in chains[:top]
+        ]

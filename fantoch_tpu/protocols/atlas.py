@@ -1,0 +1,366 @@
+"""Atlas (EuroSys'20) / EPaxos (SOSP'13) / Janus (OSDI'16): leaderless
+dependency-graph consensus over the shared graph executor.
+
+Reference parity: `fantoch_ps/src/protocol/atlas.rs` and
+`fantoch_ps/src/protocol/epaxos.rs` (Janus maps to Atlas, `README.md:11`).
+The two protocols share their whole structure and differ only in:
+
+- quorum sizes: Atlas `(n/2 + f, f + 1)` vs EPaxos `(f + (f+1)/2, f + 1)`
+  with f forced to a minority (`fantoch/src/config.rs:295-311`);
+- the coordinator acks itself in Atlas (its deps join the quorum count,
+  `atlas.rs:316-321`) but not in EPaxos (`epaxos.rs:289-300`,
+  `quorum.len() - 1` participants);
+- fast-path condition: Atlas takes it when every reported dep was reported
+  by at least `quorum - minority` members (`check_threshold`,
+  `atlas.rs:355-363`); EPaxos only when all members reported identical deps
+  (`check_equal`, `epaxos.rs:337`).
+
+Flow (same shape as Tempo, with dep sets instead of clocks): submit computes
+deps from per-key latest write/read, `MCollect` fans out, fast-quorum members
+extend the deps with their own latests and ack, the coordinator aggregates
+and either fast-path-commits or runs the dep set through single-decree synod
+(skipped prepare). `MCommit{dot, deps}` feeds the graph executor.
+
+Message kinds/payloads (int32 rows; dep sets are D = 2*KPC*(n+1) wide,
+flat dot + 1, 0 = empty):
+- MCOLLECT      [dot, quorum_mask, deps x D]
+- MCOLLECTACK   [dot, deps x D]
+- MCOMMIT       [dot, deps x D]
+- MCONSENSUS    [dot, ballot, deps x D]
+- MCONSENSUSACK [dot, ballot]
+- MGC           [frontier_0 .. frontier_{n-1}]
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..engine.types import (
+    ExecOut,
+    ProtocolDef,
+    bit,
+    empty_execout,
+    empty_outbox,
+    outbox_row,
+)
+from ..executors import graph as graph_executor
+from .common import deps as deps_mod
+from .common import gc as gc_mod
+from .common import synod as synod_mod
+
+MCOLLECT = 0
+MCOLLECTACK = 1
+MCOMMIT = 2
+MCONSENSUS = 3
+MCONSENSUSACK = 4
+MGC = 5
+N_KINDS = 6
+
+START = 0
+PAYLOAD = 1
+COLLECT = 2
+COMMIT = 3
+
+
+class AtlasState(NamedTuple):
+    kd: deps_mod.KeyDepsState
+    status: jnp.ndarray  # [n, DOTS] int32
+    qsize: jnp.ndarray  # [n, DOTS] int32 counted fast-quorum participants
+    qd: deps_mod.QuorumDepsState
+    acc_deps: jnp.ndarray  # [n, DOTS, D] int32 synod consensus value
+    prop_deps: jnp.ndarray  # [n, DOTS, D] int32 value proposed in slow path
+    synod: synod_mod.SynodState
+    bufc_valid: jnp.ndarray  # [n, DOTS] bool buffered MCommit
+    bufc_deps: jnp.ndarray  # [n, DOTS, D] int32
+    dep_overflow: jnp.ndarray  # int32 — must stay 0
+    gc: gc_mod.GCTrack
+    fast_count: jnp.ndarray  # [n] int32
+    slow_count: jnp.ndarray  # [n] int32
+    commit_count: jnp.ndarray  # [n] int32
+
+
+def _make(variant: str, n: int, keys_per_command: int, nfr: bool) -> ProtocolDef:
+    assert variant in ("atlas", "epaxos", "janus")
+    KPC = keys_per_command
+    D = deps_mod.max_union_deps(n, KPC)
+    # Janus == Atlas (commit with all deps; README.md:11)
+    self_ack = variant != "epaxos"
+    MSG_W = max(2 + D, n)
+    MAX_OUT = 1
+    MAX_EXEC = 1
+    exdef = graph_executor.make_executor(n, D)
+    EW = exdef.exec_width
+
+    def init(spec, env):
+        DOTS = spec.dots
+        z = lambda *shape: jnp.zeros(shape, jnp.int32)
+        return AtlasState(
+            kd=deps_mod.keydeps_init(n, spec.key_space),
+            status=z(n, DOTS),
+            qsize=z(n, DOTS),
+            qd=deps_mod.quorumdeps_init(n, DOTS, D),
+            acc_deps=z(n, DOTS, D),
+            prop_deps=z(n, DOTS, D),
+            synod=synod_mod.synod_init(n, DOTS),
+            bufc_valid=jnp.zeros((n, DOTS), jnp.bool_),
+            bufc_deps=z(n, DOTS, D),
+            dep_overflow=jnp.int32(0),
+            gc=gc_mod.gc_init(n, DOTS),
+            fast_count=z(n),
+            slow_count=z(n),
+            commit_count=z(n),
+        )
+
+    def _add_cmd(ctx, st: AtlasState, p, dot, past, enable):
+        keys = ctx.cmds.keys[dot]
+        kd, deps, overflow = deps_mod.add_cmd(
+            st.kd, p, dot, keys, ctx.cmds.read_only[dot], past,
+            st.dep_overflow, enable, nfr,
+        )
+        return st._replace(kd=kd, dep_overflow=overflow), deps
+
+    def _commit(ctx, st: AtlasState, p, dot, deps, enable):
+        """Commit path (atlas.rs:392-453): mark COMMIT, hand the dep set to
+        the graph executor, record for GC."""
+        st = st._replace(
+            status=st.status.at[p, dot].set(
+                jnp.where(enable, COMMIT, st.status[p, dot])
+            ),
+            acc_deps=st.acc_deps.at[p, dot].set(
+                jnp.where(enable, deps, st.acc_deps[p, dot])
+            ),
+            commit_count=st.commit_count.at[p].add(enable.astype(jnp.int32)),
+            gc=gc_mod.gc_commit(st.gc, p, dot, enable, ctx.spec.max_seq),
+        )
+        info = jnp.concatenate([dot[None], deps]).astype(jnp.int32)
+        execout = ExecOut(
+            valid=jnp.broadcast_to(enable, (MAX_EXEC,)),
+            info=info[None, :],
+        )
+        return st, execout
+
+    def submit(ctx, st: AtlasState, p, dot, now):
+        st, deps = _add_cmd(
+            ctx, st, p, dot, jnp.zeros((D,), jnp.int32), jnp.bool_(True)
+        )
+        ob = outbox_row(
+            empty_outbox(MAX_OUT, MSG_W), 0,
+            jnp.bool_(True), ctx.env.all_mask, MCOLLECT,
+            [dot, ctx.env.fq_mask[p]] + list(deps),
+        )
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def h_mcollect(ctx, st: AtlasState, p, src, payload, now):
+        dot, qmask = payload[0], payload[1]
+        rdeps = payload[2 : 2 + D]
+        is_start = st.status[p, dot] == START
+        in_q = bit(qmask, p) == 1
+        from_self = src == p
+        q_en = is_start & in_q
+
+        # quorum member extends the coordinator's deps with its own latests;
+        # from self: deps were already computed at submit
+        st, deps = _add_cmd(ctx, st, p, dot, rdeps, q_en & ~from_self)
+        deps = jnp.where(from_self, rdeps, deps)
+
+        qsz = jnp.zeros((), jnp.int32)
+        for i in range(n):
+            qsz = qsz + bit(qmask, jnp.int32(i))
+        if not self_ack:
+            qsz = qsz - 1  # EPaxosInfo: coordinator's deps aren't counted
+        not_accepted = st.synod.acc_abal[p, dot] == 0
+        st = st._replace(
+            status=st.status.at[p, dot].set(
+                jnp.where(
+                    is_start,
+                    jnp.where(in_q, COLLECT, PAYLOAD),
+                    st.status[p, dot],
+                )
+            ),
+            qsize=st.qsize.at[p, dot].set(jnp.where(q_en, qsz, st.qsize[p, dot])),
+            acc_deps=st.acc_deps.at[p, dot].set(
+                jnp.where(q_en & not_accepted, deps, st.acc_deps[p, dot])
+            ),
+        )
+        ack_en = q_en if self_ack else (q_en & ~from_self)
+        ob = outbox_row(
+            empty_outbox(MAX_OUT, MSG_W), 0,
+            ack_en, jnp.int32(1) << src, MCOLLECTACK, [dot] + list(deps),
+        )
+        # non-quorum member: payload only; flush a buffered commit
+        flush = is_start & ~in_q & st.bufc_valid[p, dot]
+        st = st._replace(
+            bufc_valid=st.bufc_valid.at[p, dot].set(st.bufc_valid[p, dot] & ~flush)
+        )
+        st, execout = _commit(ctx, st, p, dot, st.bufc_deps[p, dot], flush)
+        return st, ob, execout
+
+    def h_mcollectack(ctx, st: AtlasState, p, src, payload, now):
+        dot = payload[0]
+        rdeps = payload[1 : 1 + D]
+        collect = st.status[p, dot] == COLLECT
+        st = st._replace(qd=deps_mod.quorumdeps_add(st.qd, p, dot, rdeps, collect))
+
+        count = st.qd.count[p, dot]
+        all_in = collect & (count == st.qsize[p, dot])
+        if self_ack:
+            # Atlas: every dep reported >= quorum - minority times
+            threshold = st.qsize[p, dot] - n // 2
+        else:
+            # EPaxos: all counted members reported identical deps
+            threshold = st.qsize[p, dot]
+        union, thr_ok = deps_mod.quorumdeps_check(st.qd, p, dot, threshold)
+        fast = all_in & thr_ok
+        slow = all_in & ~thr_ok
+
+        st = st._replace(
+            synod=synod_mod.skip_prepare(st.synod, p, dot, jnp.int32(0), slow),
+            prop_deps=st.prop_deps.at[p, dot].set(
+                jnp.where(slow, union, st.prop_deps[p, dot])
+            ),
+            fast_count=st.fast_count.at[p].add(fast.astype(jnp.int32)),
+            slow_count=st.slow_count.at[p].add(slow.astype(jnp.int32)),
+        )
+        row_kind = jnp.where(fast, MCOMMIT, MCONSENSUS)
+        row_tgt = jnp.where(fast, ctx.env.all_mask, ctx.env.wq_mask[p])
+        commit_payload = jnp.concatenate([dot[None], union]).astype(jnp.int32)
+        cons_payload = jnp.concatenate(
+            [dot[None], (p + 1)[None], union]
+        ).astype(jnp.int32)
+        width = cons_payload.shape[0]
+        commit_payload = jnp.concatenate(
+            [commit_payload, jnp.zeros((width - commit_payload.shape[0],), jnp.int32)]
+        )
+        pay = jnp.where(fast, commit_payload, cons_payload)
+        ob = outbox_row(
+            empty_outbox(MAX_OUT, MSG_W), 0, all_in, row_tgt, row_kind, list(pay)
+        )
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def h_mcommit(ctx, st: AtlasState, p, src, payload, now):
+        dot = payload[0]
+        deps = payload[1 : 1 + D]
+        is_start = st.status[p, dot] == START
+        can_commit = (st.status[p, dot] == PAYLOAD) | (st.status[p, dot] == COLLECT)
+        st = st._replace(
+            bufc_valid=st.bufc_valid.at[p, dot].set(st.bufc_valid[p, dot] | is_start),
+            bufc_deps=st.bufc_deps.at[p, dot].set(
+                jnp.where(is_start, deps, st.bufc_deps[p, dot])
+            ),
+        )
+        st, execout = _commit(ctx, st, p, dot, deps, can_commit)
+        return st, empty_outbox(MAX_OUT, MSG_W), execout
+
+    def h_mconsensus(ctx, st: AtlasState, p, src, payload, now):
+        dot, ballot = payload[0], payload[1]
+        deps = payload[2 : 2 + D]
+        chosen = st.status[p, dot] == COMMIT
+        sy, accepted = synod_mod.handle_accept(st.synod, p, dot, ballot, jnp.int32(0))
+        take = ~chosen & accepted
+        st = st._replace(
+            synod=jax.tree_util.tree_map(
+                lambda a, b: jnp.where(chosen, a, b), st.synod, sy
+            ),
+            acc_deps=st.acc_deps.at[p, dot].set(
+                jnp.where(take, deps, st.acc_deps[p, dot])
+            ),
+        )
+        # already chosen: reply MCommit with the chosen deps (atlas.rs:489-492)
+        commit_payload = jnp.concatenate([dot[None], st.acc_deps[p, dot]])
+        ack_payload = jnp.concatenate(
+            [dot[None], ballot[None], jnp.zeros((D - 1,), jnp.int32)]
+        )
+        pay = jnp.where(chosen, commit_payload, ack_payload)
+        ob = outbox_row(
+            empty_outbox(MAX_OUT, MSG_W), 0,
+            chosen | accepted,
+            jnp.int32(1) << src,
+            jnp.where(chosen, MCOMMIT, MCONSENSUSACK),
+            list(pay),
+        )
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def h_mconsensusack(ctx, st: AtlasState, p, src, payload, now):
+        dot, ballot = payload[0], payload[1]
+        not_committed = st.status[p, dot] != COMMIT
+        sy, chosen, _ = synod_mod.handle_accepted(
+            st.synod, p, dot, ballot, ctx.env.wq_size
+        )
+        chosen = chosen & not_committed
+        st = st._replace(synod=sy)
+        ob = outbox_row(
+            empty_outbox(MAX_OUT, MSG_W), 0,
+            chosen, ctx.env.all_mask, MCOMMIT,
+            [dot] + list(st.prop_deps[p, dot]),
+        )
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def h_mgc(ctx, st: AtlasState, p, src, payload, now):
+        st = st._replace(gc=gc_mod.gc_handle_mgc(st.gc, p, src, payload[:n]))
+        return st, empty_outbox(MAX_OUT, MSG_W), empty_execout(MAX_EXEC, EW)
+
+    def handle(ctx, st, p, src, kind, payload, now):
+        branches = [
+            functools.partial(h, ctx)
+            for h in (
+                h_mcollect,
+                h_mcollectack,
+                h_mcommit,
+                h_mconsensus,
+                h_mconsensusack,
+                h_mgc,
+            )
+        ]
+        return jax.lax.switch(kind, branches, st, p, src, payload, now)
+
+    def periodic(ctx, st: AtlasState, p, kind, now):
+        all_but_me = ctx.env.all_mask & ~(jnp.int32(1) << p)
+        row = gc_mod.gc_frontier_row(st.gc, p)
+        ob = outbox_row(
+            empty_outbox(1, MSG_W), 0,
+            jnp.bool_(True), all_but_me, MGC, [row[a] for a in range(n)],
+        )
+        return st, ob
+
+    def metrics(st: AtlasState):
+        return {
+            "stable": st.gc.stable_count,
+            "commits": st.commit_count,
+            "fast": st.fast_count,
+            "slow": st.slow_count,
+        }
+
+    def quorum_sizes(cfg):
+        if variant == "epaxos":
+            fast, write = cfg.epaxos_quorum_sizes()
+        else:
+            fast, write = cfg.atlas_quorum_sizes()
+        return fast, write, 0
+
+    return ProtocolDef(
+        name=variant,
+        n_msg_kinds=N_KINDS,
+        msg_width=MSG_W,
+        max_out=MAX_OUT,
+        max_exec=MAX_EXEC,
+        executor=exdef,
+        init=init,
+        submit=submit,
+        handle=handle,
+        periodic_events=(("garbage_collection", lambda cfg: cfg.gc_interval_ms),),
+        periodic=periodic,
+        quorum_sizes=quorum_sizes,
+        leaderless=True,
+        metrics=metrics,
+    )
+
+
+def make_protocol(n: int, keys_per_command: int = 1, nfr: bool = False) -> ProtocolDef:
+    return _make("atlas", n, keys_per_command, nfr)
+
+
+def make_janus(n: int, keys_per_command: int = 1, nfr: bool = False) -> ProtocolDef:
+    return _make("janus", n, keys_per_command, nfr)

@@ -1,0 +1,139 @@
+"""Per-key dependency tracking + quorum dep aggregation (Atlas/EPaxos).
+
+Reference parity: `fantoch_ps/src/protocol/common/graph/deps/`:
+
+- `KeyDeps` (`keys/sequential.rs`): per key, the latest write and latest
+  read; a command's dependencies are, per key it touches, the latest write
+  (always) and the latest read (only for writes without NFR) —
+  `keys/mod.rs:44-75` `maybe_add_deps`; the command then becomes the new
+  latest write (or read, if read-only);
+- `QuorumDeps` (`quorum.rs`): counts how many fast-quorum members reported
+  each dependency; the fast-path checks are `check_threshold` (Atlas: every
+  dep reported >= threshold times) and `check_equal` (EPaxos: every dep
+  reported by every counted member).
+
+Device layout: dependency sets are fixed-width int32 rows of `flat_dot + 1`
+(0 = empty slot) with linear-scan dedup; per-key latests are `[n, K]`
+tensors; the quorum counter is a `[n, DOTS, D]` slot map.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+def max_union_deps(n: int, keys_per_command: int) -> int:
+    """Upper bound on a committed dep set: the coordinator's own deps plus
+    <= 2 per key per fast-quorum member (write + read latest)."""
+    return 2 * keys_per_command * (n + 1)
+
+
+class KeyDepsState(NamedTuple):
+    latest_w: jnp.ndarray  # [n, K] int32 flat dot + 1 of latest write (0 none)
+    latest_r: jnp.ndarray  # [n, K] int32 flat dot + 1 of latest read
+
+
+def keydeps_init(n: int, key_space: int) -> KeyDepsState:
+    z = jnp.zeros((n, key_space), jnp.int32)
+    return KeyDepsState(z, z)
+
+
+def set_insert(deps: jnp.ndarray, value, enable, overflow):
+    """Insert `value` (flat dot + 1) into a fixed-width dep set with dedup.
+
+    Returns (deps, overflow). `overflow` counts inserts lost to a full row —
+    an engine invariant (sized by `max_union_deps` it cannot trigger, but we
+    track it like every other capacity bound).
+    """
+    enable = jnp.asarray(enable) & (value > 0)
+    present = (deps == value).any()
+    free = deps == 0
+    slot = jnp.argmax(free)
+    do = enable & ~present & free.any()
+    deps = deps.at[slot].set(jnp.where(do, value, deps[slot]))
+    overflow = overflow + (enable & ~present & ~free.any()).astype(jnp.int32)
+    return deps, overflow
+
+
+def add_cmd(
+    kd: KeyDepsState,
+    p,
+    dot,
+    keys,  # [KPC] traced key ids
+    read_only,  # traced bool
+    deps,  # [D] dep row to accumulate into (the `past`)
+    overflow,
+    enable,
+    nfr: bool,
+):
+    """KeyDeps::add_cmd — collect deps from the per-key latests, then record
+    this command as the new latest write/read on each key."""
+    kpc = len(keys) if isinstance(keys, (list, tuple)) else keys.shape[0]
+    enable = jnp.asarray(enable)
+    lw, lr = kd.latest_w, kd.latest_r
+    for i in range(kpc):
+        k = keys[i]
+        deps, overflow = set_insert(deps, lw[p, k], enable, overflow)
+        if not nfr:
+            # writes also depend on the latest read (keys/mod.rs:66-70)
+            deps, overflow = set_insert(
+                deps, jnp.where(read_only, 0, lr[p, k]), enable, overflow
+            )
+        new_latest = dot + 1
+        lw = lw.at[p, k].set(
+            jnp.where(enable & ~read_only, new_latest, lw[p, k])
+        )
+        lr = lr.at[p, k].set(jnp.where(enable & read_only, new_latest, lr[p, k]))
+    return kd._replace(latest_w=lw, latest_r=lr), deps, overflow
+
+
+class QuorumDepsState(NamedTuple):
+    count: jnp.ndarray  # [n, DOTS] int32 participants
+    dep: jnp.ndarray  # [n, DOTS, D] int32 dep slots (flat dot + 1)
+    cnt: jnp.ndarray  # [n, DOTS, D] int32 report count per slot
+    overflow: jnp.ndarray  # int32 — must stay 0
+
+
+def quorumdeps_init(n: int, dots: int, max_deps: int) -> QuorumDepsState:
+    return QuorumDepsState(
+        count=jnp.zeros((n, dots), jnp.int32),
+        dep=jnp.zeros((n, dots, max_deps), jnp.int32),
+        cnt=jnp.zeros((n, dots, max_deps), jnp.int32),
+        overflow=jnp.int32(0),
+    )
+
+
+def quorumdeps_add(qd: QuorumDepsState, p, dot, deps, enable):
+    """QuorumDeps::add — count one participant's dep set (already deduped)."""
+    enable = jnp.asarray(enable)
+    D = qd.dep.shape[2]
+    row_dep = qd.dep[p, dot]
+    row_cnt = qd.cnt[p, dot]
+    overflow = qd.overflow
+    for j in range(deps.shape[0]):
+        v = deps[j]
+        add = enable & (v > 0)
+        present = row_dep == v
+        hit = present.any()
+        free = row_dep == 0
+        slot = jnp.where(hit, jnp.argmax(present), jnp.argmax(free))
+        ok = add & (hit | free.any())
+        row_dep = row_dep.at[slot].set(jnp.where(ok, v, row_dep[slot]))
+        row_cnt = row_cnt.at[slot].add(jnp.where(ok, 1, 0))
+        overflow = overflow + (add & ~ok).astype(jnp.int32)
+    return qd._replace(
+        count=qd.count.at[p, dot].add(enable.astype(jnp.int32)),
+        dep=qd.dep.at[p, dot].set(row_dep),
+        cnt=qd.cnt.at[p, dot].set(row_cnt),
+        overflow=overflow,
+    )
+
+
+def quorumdeps_check(qd: QuorumDepsState, p, dot, threshold):
+    """`check_threshold` — (union, every-dep-reported >= threshold times).
+    With threshold == number of counted participants this is `check_equal`."""
+    row_dep = qd.dep[p, dot]
+    row_cnt = qd.cnt[p, dot]
+    ok = ((row_dep == 0) | (row_cnt >= threshold)).all()
+    return row_dep, ok

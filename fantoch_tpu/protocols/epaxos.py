@@ -1,0 +1,17 @@
+"""EPaxos (SOSP'13): egalitarian Paxos over the shared dep-graph machinery.
+
+Reference parity: `fantoch_ps/src/protocol/epaxos.rs` — structurally Atlas
+with (a) fast quorum `f + (f+1)/2` where f is forced to a minority
+(`fantoch/src/config.rs:304-311`), (b) no coordinator self-ack
+(`epaxos.rs:289-300`), and (c) the all-equal fast-path condition
+(`check_equal`, `epaxos.rs:337`). See `protocols/atlas.py` for the shared
+implementation and the full message catalogue.
+"""
+from __future__ import annotations
+
+from ..engine.types import ProtocolDef
+from .atlas import _make
+
+
+def make_protocol(n: int, keys_per_command: int = 1, nfr: bool = False) -> ProtocolDef:
+    return _make("epaxos", n, keys_per_command, nfr)

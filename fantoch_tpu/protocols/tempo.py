@@ -194,6 +194,15 @@ def make_protocol(
             )
         return st, ob
 
+    def _mcommit_payload(votes_s, votes_e, p, dot, clock):
+        """MCommit wire layout: [dot, clock, (start,end) x KPC x n] —
+        decoded by h_mcommit's stride-2 slices."""
+        payload = [dot, clock]
+        for k in range(KPC):
+            for v in range(n):
+                payload += [votes_s[p, dot, k, v], votes_e[p, dot, k, v]]
+        return payload
+
     # ------------------------------------------------------------------
     # commit path (tempo.rs:575-674)
     # ------------------------------------------------------------------
@@ -350,10 +359,7 @@ def make_protocol(
         slow = all_in & ~(new_cnt >= threshold)
 
         # fast path: MCommit with the aggregated votes
-        commit_payload = [dot, new_max]
-        for k in range(KPC):
-            for v in range(n):
-                commit_payload += [votes_s[p, dot, k, v], votes_e[p, dot, k, v]]
+        commit_payload = _mcommit_payload(votes_s, votes_e, p, dot, new_max)
         # slow path: synod with skipped prepare (ballot = 1-based own id)
         st = st._replace(
             synod=synod_mod.skip_prepare(st.synod, p, dot, new_max, slow),
@@ -428,10 +434,9 @@ def make_protocol(
         )
         # already chosen: reply MCommit with the stored votes (tempo.rs:780-786);
         # otherwise ack the accept
-        commit_payload = [dot, st.synod.acc_val[p, dot]]
-        for k in range(KPC):
-            for v in range(n):
-                commit_payload += [st.votes_s[p, dot, k, v], st.votes_e[p, dot, k, v]]
+        commit_payload = _mcommit_payload(
+            st.votes_s, st.votes_e, p, dot, st.synod.acc_val[p, dot]
+        )
         ack_payload = [dot, ballot] + [jnp.int32(0)] * (len(commit_payload) - 2)
         pay = jnp.where(
             chosen,
@@ -455,10 +460,7 @@ def make_protocol(
         )
         chosen = chosen & not_committed
         st = st._replace(synod=sy)
-        commit_payload = [dot, value]
-        for k in range(KPC):
-            for v in range(n):
-                commit_payload += [st.votes_s[p, dot, k, v], st.votes_e[p, dot, k, v]]
+        commit_payload = _mcommit_payload(st.votes_s, st.votes_e, p, dot, value)
         ob = outbox_row(
             empty_outbox(MAX_OUT, MSG_W), 0,
             chosen, ctx.env.all_mask, MCOMMIT, commit_payload,

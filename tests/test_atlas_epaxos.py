@@ -1,0 +1,124 @@
+"""End-to-end golden tests for Atlas/EPaxos/Janus + GraphExecutor.
+
+Mirrors the reference's sim-based tests (`fantoch_ps/src/protocol/mod.rs`,
+atlas/epaxos sections):
+
+- fast-path matrix: Atlas n=3 f=1 and n=5 f=1 commit with 0 slow paths
+  (threshold 1); Atlas n=5 f=2 under conflicts takes slow paths; EPaxos n=3
+  is always fast (one counted member), n=5 under conflicts is not;
+- every command commits and executes at every process;
+- GC completeness (stable == commands at every process);
+- cross-replica per-key execution order agreement (the graph executor's SCC
+  ordering is deterministic given the committed graph).
+"""
+import jax
+import numpy as np
+
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.planet import Planet
+from fantoch_tpu.core.workload import KeyGen, Workload
+from fantoch_tpu.engine import lockstep, setup, summary
+from fantoch_tpu.protocols import atlas as atlas_proto
+from fantoch_tpu.protocols import epaxos as epaxos_proto
+
+COMMANDS_PER_CLIENT = 20
+PROCESS_REGIONS = ["asia-east1", "us-central1", "us-west1", "us-west2", "europe-west2"]
+CLIENT_REGIONS = ["us-west1", "us-west2"]
+
+
+def run(
+    proto: str,
+    n: int,
+    f: int,
+    conflict_rate: int = 50,
+    clients_per_region: int = 2,
+    keys_per_command: int = 1,
+    reorder: bool = False,
+    seed: int = 0,
+):
+    planet = Planet.new()
+    config = Config(n=n, f=f, gc_interval_ms=50)
+    workload = Workload(
+        shard_count=1,
+        key_gen=KeyGen.conflict_pool(conflict_rate=conflict_rate, pool_size=1),
+        keys_per_command=keys_per_command,
+        commands_per_client=COMMANDS_PER_CLIENT,
+    )
+    make = {
+        "atlas": atlas_proto.make_protocol,
+        "janus": atlas_proto.make_janus,
+        "epaxos": epaxos_proto.make_protocol,
+    }[proto]
+    pdef = make(n, workload.keys_per_command)
+    C = len(CLIENT_REGIONS) * clients_per_region
+    spec = setup.build_spec(
+        config, workload, pdef, n_clients=C, n_client_groups=len(CLIENT_REGIONS),
+        extra_ms=2000, max_steps=5_000_000, reorder=reorder,
+    )
+    placement = setup.Placement(PROCESS_REGIONS[:n], CLIENT_REGIONS, clients_per_region)
+    env = setup.build_env(spec, config, planet, placement, workload, pdef, seed=seed)
+    st = jax.jit(lockstep.make_run(spec, pdef, workload))(env)
+    st = jax.tree_util.tree_map(np.asarray, st)
+    summary.check_sim_health(st)
+    metrics = summary.protocol_metrics(st, pdef)
+    return st, metrics, spec
+
+
+def check(st, metrics, spec):
+    total = spec.n_clients * COMMANDS_PER_CLIENT
+    assert (metrics["commits"] == total).all(), metrics["commits"]
+    assert (metrics["fast"] + metrics["slow"]).sum() == total
+    # every process executes every command
+    assert (st.exec.executed_count == total).all(), st.exec.executed_count
+    assert (metrics["stable"] == total).all(), metrics["stable"]
+    # cross-replica per-key execution order agreement
+    assert (st.exec.order_cnt == st.exec.order_cnt[0]).all()
+    assert (st.exec.order_hash == st.exec.order_hash[0]).all(), st.exec.order_hash
+
+
+def test_atlas_n3_f1():
+    st, metrics, spec = run("atlas", 3, 1)
+    check(st, metrics, spec)
+    assert metrics["slow"].sum() == 0, metrics["slow"]
+
+
+def test_atlas_n5_f1():
+    st, metrics, spec = run("atlas", 5, 1)
+    check(st, metrics, spec)
+    assert metrics["slow"].sum() == 0, metrics["slow"]
+
+
+def test_atlas_n5_f2_takes_slow_paths():
+    st, metrics, spec = run("atlas", 5, 2, conflict_rate=100, reorder=True, seed=3)
+    check(st, metrics, spec)
+    assert metrics["slow"].sum() > 0, metrics["slow"]
+
+
+def test_atlas_n3_f1_reorder():
+    st, metrics, spec = run("atlas", 3, 1, reorder=True, seed=7)
+    check(st, metrics, spec)
+
+
+def test_atlas_multi_key():
+    st, metrics, spec = run("atlas", 3, 1, keys_per_command=2)
+    total = spec.n_clients * COMMANDS_PER_CLIENT
+    assert (metrics["commits"] == total).all()
+    assert (st.exec.executed_count == total).all()
+    assert (st.exec.order_hash == st.exec.order_hash[0]).all()
+
+
+def test_janus_n3_f1():
+    st, metrics, spec = run("janus", 3, 1)
+    check(st, metrics, spec)
+
+
+def test_epaxos_n3():
+    st, metrics, spec = run("epaxos", 3, 1)
+    check(st, metrics, spec)
+    assert metrics["slow"].sum() == 0, metrics["slow"]
+
+
+def test_epaxos_n5_takes_slow_paths():
+    st, metrics, spec = run("epaxos", 5, 2, conflict_rate=100, seed=1)
+    check(st, metrics, spec)
+    assert metrics["slow"].sum() > 0, metrics["slow"]

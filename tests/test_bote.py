@@ -1,0 +1,124 @@
+"""Golden tests for the closed-form planner (fantoch_bote equivalent).
+
+All expected values are the reference's own unit-test values
+(`fantoch_bote/src/lib.rs:192-420` quorum_latencies / leaderless / leader
+tests, GCP planet, europe-west regions).
+"""
+import numpy as np
+import pytest
+
+from fantoch_tpu.core.metrics import Histogram
+from fantoch_tpu.planner.bote import (
+    ATLAS,
+    EPAXOS,
+    FPAXOS,
+    Bote,
+    RankingParams,
+    Search,
+    quorum_size,
+)
+
+W = ["europe-west1", "europe-west2", "europe-west3", "europe-west4", "europe-west6"]
+
+
+@pytest.fixture(scope="module")
+def bote():
+    return Bote()
+
+
+def test_quorum_sizes():
+    # protocol.rs tests
+    assert quorum_size(FPAXOS, 3, 1) == 2
+    assert quorum_size(FPAXOS, 5, 2) == 3
+    assert quorum_size(EPAXOS, 3, 0) == 2
+    assert quorum_size(EPAXOS, 5, 0) == 3
+    assert quorum_size(EPAXOS, 7, 0) == 5
+    assert quorum_size(EPAXOS, 9, 0) == 6
+    assert quorum_size(EPAXOS, 11, 0) == 8
+    assert quorum_size(EPAXOS, 13, 0) == 9
+    assert quorum_size(ATLAS, 3, 1) == 2
+    assert quorum_size(ATLAS, 5, 1) == 3
+    assert quorum_size(ATLAS, 5, 2) == 4
+
+
+def test_quorum_latencies(bote):
+    # lib.rs quorum_latencies golden values
+    for region, q2, q3 in [
+        ("europe-west1", 7, 8),
+        ("europe-west2", 9, 10),
+        ("europe-west3", 7, 7),
+        ("europe-west4", 7, 7),
+        ("europe-west6", 7, 14),
+    ]:
+        assert bote.quorum_latency(region, W, 2) == q2, region
+        assert bote.quorum_latency(region, W, 3) == q3, region
+
+
+def _hist(stats):
+    return Histogram.from_values([lat for _r, lat in stats])
+
+
+def test_leaderless(bote):
+    h = _hist(bote.leaderless(W, W, 3))
+    assert round(h.mean(), 1) == 9.2
+    assert round(h.cov(), 1) == 0.3
+    assert round(h.mdtm(), 1) == 2.2
+    h = _hist(bote.leaderless(W, W, 4))
+    assert round(h.mean(), 1) == 10.8
+    assert round(h.cov(), 1) == 0.2
+    assert round(h.mdtm(), 1) == 2.2
+
+
+def test_leaderless_clients_subset(bote):
+    h = _hist(bote.leaderless(W, ["europe-west1", "europe-west2"], 3))
+    assert round(h.mean(), 1) == 9.0
+    h = _hist(bote.leaderless(W, ["europe-west1", "europe-west3", "europe-west6"], 4))
+    assert round(h.mean(), 1) == 10.7
+    assert round(h.mdtm(), 1) == 2.2
+
+
+def test_leader(bote):
+    h = _hist(bote.leader("europe-west1", W, W, 2))
+    assert round(h.mean(), 1) == 14.8
+    assert round(h.cov(), 1) == 0.3
+    assert round(h.mdtm(), 1) == 3.4
+    h = _hist(bote.leader("europe-west2", W, W, 2))
+    assert round(h.mean(), 1) == 19.2
+    h = _hist(bote.leader("europe-west3", W, W, 2))
+    assert round(h.mean(), 1) == 14.0
+
+
+def test_best_leader(bote):
+    # the best mean leader among the europe-west regions at q=2 is w3 (14.0)
+    leader, h = bote.best_leader(W, W, 2, sort_by="mean")
+    assert leader == "europe-west3"
+    assert round(h.mean(), 1) == 14.0
+
+
+def test_search_small():
+    # exhaustive scored search over all size-3/5 subsets of the 5 regions
+    bote = Bote(regions=W)
+    s = Search(bote, ns=[3, 5], clients=W)
+    s.compute()
+    assert s.configs[3].shape == (10, 5)
+    assert s.configs[5].shape == (1, 5)
+    # scoring matches a direct host-side recomputation for one config
+    mask = s.configs[3][0]
+    servers = [r for r, m in zip(bote.regions, mask) if m]
+    h = _hist(bote.leaderless(servers, W, quorum_size(ATLAS, 3, 1)))
+    assert np.isclose(s.stats[3]["atlas_f1"][0, 0], h.mean(), atol=1e-3)
+    # ranking and evolving-config chains run end to end
+    params = RankingParams(
+        min_mean_fpaxos_improv=-1000,
+        min_mean_epaxos_improv=-1000,
+        min_fairness_fpaxos_improv=-1000,
+        min_mean_decrease=-1000,
+        ft_metric="f1",
+    )
+    ranked = s.rank(3, params)
+    assert len(ranked) == 10
+    chains = s.sorted_evolving_configs(params, top=5)
+    assert chains and all(len(cfgs) == 2 for _s, cfgs in chains)
+    # every chain is a superset chain
+    for _score, (m3, m5) in chains:
+        assert (m3 & m5).sum() == m3.sum()
