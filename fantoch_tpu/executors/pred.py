@@ -1,0 +1,141 @@
+"""Predecessors executor: (clock, deps) ordering for Caesar.
+
+Reference parity: `fantoch_ps/src/executor/pred/mod.rs` — each committed
+command carries a timestamp `clock` and a predecessor set `deps`; it may
+execute once
+
+- phase one: every dependency is *committed* (`move_to_phase_one`,
+  `pred/mod.rs:154-204`), and
+- phase two: every dependency with a *lower clock* is *executed*
+  (`move_to_phase_two`, `pred/mod.rs:206-275`)
+
+(higher-clock dependencies will order themselves after us, so only the lower
+side is awaited). The reference tracks this with two pending indexes and
+cascading retries; on device both phases collapse into one readiness
+predicate over the committed window, evaluated to fixpoint after every
+commit: ready commands execute in ascending `(clock, dot)` — a deterministic
+linear extension of the reference's unblock cascade that preserves the
+per-key clock order all replicas agree on.
+
+Execution-info row (width 2 + BW): ``[dot, clock, deps_bitmap x BW]``
+(`PredecessorsExecutionInfo`, `pred/executor.rs`).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..engine.types import ExecutorDef
+from ..protocols.common.bitmap import bm_pack, bm_unpack, bm_words
+from .ready import ReadyRing, ready_capacity, ready_drain, ready_init, ready_push, writer_id
+
+ORDER_HASH_MULT = jnp.int32(0x01000193)
+
+
+class PredExecState(NamedTuple):
+    kvs: jnp.ndarray  # [n, K] int32
+    committed: jnp.ndarray  # [n, DOTS] bool
+    executed: jnp.ndarray  # [n, DOTS] bool
+    clock: jnp.ndarray  # [n, DOTS] int32 composite (seq, pid) clock
+    deps: jnp.ndarray  # [n, DOTS, BW] int32 predecessor bitmap
+    order_hash: jnp.ndarray  # [n, K] int32
+    order_cnt: jnp.ndarray  # [n, K] int32
+    executed_count: jnp.ndarray  # [n] int32
+    chain_max: jnp.ndarray  # [n] int32 largest ready batch per call
+    ready: ReadyRing
+
+
+def make_executor(n: int, max_seq: int) -> ExecutorDef:
+    DOTS = n * max_seq
+    BW = bm_words(DOTS)
+    EW = 2 + BW
+
+    def init(spec, env):
+        assert spec.dots == DOTS, (
+            f"Caesar executor compiled for max_seq={max_seq}, spec has {spec.max_seq}"
+        )
+        return PredExecState(
+            kvs=jnp.zeros((n, spec.key_space), jnp.int32),
+            committed=jnp.zeros((n, DOTS), jnp.bool_),
+            executed=jnp.zeros((n, DOTS), jnp.bool_),
+            clock=jnp.zeros((n, DOTS), jnp.int32),
+            deps=jnp.zeros((n, DOTS, BW), jnp.int32),
+            order_hash=jnp.zeros((n, spec.key_space), jnp.int32),
+            order_cnt=jnp.zeros((n, spec.key_space), jnp.int32),
+            executed_count=jnp.zeros((n,), jnp.int32),
+            chain_max=jnp.zeros((n,), jnp.int32),
+            ready=ready_init(n, ready_capacity(spec)),
+        )
+
+    def _ready_set(est: PredExecState, p):
+        """Commands whose both phases are satisfied right now."""
+        V = est.committed[p] & ~est.executed[p]  # [DOTS]
+        bits = bm_unpack(est.deps[p], DOTS)  # [DOTS(cmd), DOTS(dep)]
+        committed_ok = ~(bits & ~est.committed[p][None, :]).any(axis=1)
+        lower = est.clock[p][None, :] < est.clock[p][:, None]  # dep clock < cmd clock
+        executed_ok = ~(bits & lower & ~est.executed[p][None, :]).any(axis=1)
+        return V & committed_ok & executed_ok
+
+    def _try_execute(ctx, est: PredExecState, p):
+        KPC = ctx.spec.keys_per_command
+        dots = jnp.arange(DOTS, dtype=jnp.int32)
+        est = est._replace(chain_max=est.chain_max.at[p].max(_ready_set(est, p).sum()))
+
+        def cond(e):
+            return _ready_set(e, p).any()
+
+        def body(e):
+            ready = _ready_set(e, p)
+            # execute the (clock, dot)-minimal ready command
+            ckey = jnp.where(ready, e.clock[p], jnp.int32(2**30))
+            cmin = ckey.min()
+            d = jnp.where(ckey == cmin, dots, jnp.int32(2**30)).min()
+            client = ctx.cmds.client[d]
+            rifl = ctx.cmds.rifl_seq[d]
+            kvs, oh, oc, ring = e.kvs, e.order_hash, e.order_cnt, e.ready
+            for k in range(KPC):
+                key = ctx.cmds.keys[d, k]
+                kvs = kvs.at[p, key].set(writer_id(client, rifl))
+                oh = oh.at[p, key].set(oh[p, key] * ORDER_HASH_MULT + (d + 1))
+                oc = oc.at[p, key].add(1)
+                ring = ready_push(ring, p, client, rifl)
+            return e._replace(
+                kvs=kvs,
+                order_hash=oh,
+                order_cnt=oc,
+                ready=ring,
+                executed=e.executed.at[p, d].set(True),
+                executed_count=e.executed_count.at[p].add(1),
+            )
+
+        return jax.lax.while_loop(cond, body, est)
+
+    def handle(ctx, est: PredExecState, p, info, now):
+        dot = info[0]
+        est = est._replace(
+            committed=est.committed.at[p, dot].set(True),
+            clock=est.clock.at[p, dot].set(info[1]),
+            deps=est.deps.at[p, dot].set(info[2 : 2 + BW]),
+        )
+        return _try_execute(ctx, est, p)
+
+    def drain(ctx, est: PredExecState, p):
+        ring, res = ready_drain(est.ready, p, ctx.spec.max_res)
+        return est._replace(ready=ring), res
+
+    def executed(ctx, est: PredExecState, p):
+        """CommittedAndExecuted notification: the cumulative executed bitmap
+        (idempotent analogue of the reference's drained `new_executed_dots`)."""
+        return est, bm_pack(est.executed[p], BW)
+
+    return ExecutorDef(
+        name="pred",
+        exec_width=EW,
+        init=init,
+        handle=handle,
+        drain=drain,
+        executed_width=BW,
+        executed=executed,
+    )

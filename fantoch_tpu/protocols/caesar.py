@@ -1,0 +1,593 @@
+"""Caesar: timestamp + predecessor consensus (DSN'17), leaderless.
+
+Reference parity: `fantoch_ps/src/protocol/caesar.rs` +
+`fantoch_ps/src/protocol/common/pred/` — the wait-condition protocol:
+
+- submit: the coordinator picks a unique timestamp `clock_next()` and
+  broadcasts `MPropose{dot, cmd, clock}` to *all* processes
+  (`caesar.rs:245-264` — everyone, so the fastest ok-replying quorum wins);
+- on `MPropose`, each process computes the command's predecessors (all
+  conflicting commands with lower clock) and checks the *wait condition*: a
+  conflicting command with a *higher* clock blocks the proposal until its
+  own clock/deps are safe (ACCEPT/COMMIT); once safe, it is ignorable iff
+  its deps contain the proposed dot, else the proposal is rejected with a
+  fresh higher clock + full predecessor set (`caesar.rs:266-510`,
+  `safe_to_ignore:941-958`);
+- the coordinator aggregates `MProposeAck{clock, deps, ok}`: all-ok from the
+  fast quorum (3n/4 + 1) commits on the fast path; any not-ok after a
+  majority triggers `MRetry` with the max clock + union deps; retry acks
+  from a write quorum commit on the slow path (`quorum.rs:40-80`,
+  `caesar.rs:512-606,767-830`);
+- `MCommit{dot, clock, deps}` feeds the predecessors executor and unblocks
+  proposals waiting on this command (`try_to_unblock`, `caesar.rs:960-1100`);
+- GC: executed dots are broadcast periodically; a dot executed at all n
+  processes is stable and leaves the key clocks (`BasicGCTrack`,
+  `fantoch/src/protocol/gc/basic.rs`; `caesar.rs:832-880`).
+
+TPU-native deviations (behavior-preserving):
+- `Clock{seq, pid}` lexicographic pairs become the composite int32
+  ``seq * 32 + p`` (n <= 32), preserving order and uniqueness;
+- dep sets are dense dot-window bitmaps (`common/bitmap.py`) instead of
+  `HashSet<Dot>`;
+- `try_to_unblock` cascades run as 0-delay self-messages (`MUNBLOCK`): each
+  scan decides at most one waiting proposal against the *current* dot table
+  and reschedules itself while more decisions are pending — same simulated
+  time, bounded per-handler work (the device answer to
+  `try_to_unblock_again`, `caesar.rs:43`);
+- GC executed-sets ride as cumulative bitmaps (idempotent), replacing the
+  drained `new_executed_dots` vectors + per-dot counters.
+
+Message kinds/payloads (int32 rows, BW = dep-bitmap words):
+- MPROPOSE    [dot, clock]
+- MPROPOSEACK [dot, clock, ok, deps x BW]
+- MCOMMIT     [dot, clock, from, deps x BW]
+- MRETRY      [dot, clock, from, deps x BW]
+- MRETRYACK   [dot, from, ok?, deps x BW]   (from = acker, for symmetry)
+- MUNBLOCK    []                             (self only)
+- MGC         [executed x BW]
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.ids import dot_proc
+from ..engine.types import (
+    ExecOut,
+    ProtocolDef,
+    empty_execout,
+    empty_outbox,
+    outbox_row,
+)
+from ..executors import pred as pred_executor
+from .common.bitmap import bm_clear, bm_count, bm_get, bm_pack, bm_unpack, bm_words
+
+MPROPOSE = 0
+MPROPOSEACK = 1
+MCOMMIT = 2
+MRETRY = 3
+MRETRYACK = 4
+MUNBLOCK = 5
+MGC = 6
+N_KINDS = 7
+
+# status (caesar.rs Status; PROPOSE covers PROPOSE_BEGIN/END — handlers are
+# atomic here, so the BEGIN window is never observable across events)
+START = 0
+PROPOSE = 1
+REJECT = 2
+ACCEPT = 3
+COMMIT = 4
+
+CLOCK_PIDS = 32  # composite clock = seq * CLOCK_PIDS + p
+
+
+class CaesarState(NamedTuple):
+    clk_cur: jnp.ndarray  # [n] int32 current composite clock (clock_next/join)
+    status: jnp.ndarray  # [n, DOTS] int32
+    clock_of: jnp.ndarray  # [n, DOTS] int32 registered clock (0 = none)
+    in_clocks: jnp.ndarray  # [n, DOTS] bool — registered in key clocks
+    deps: jnp.ndarray  # [n, DOTS, BW] int32 current dep bitmap
+    blockedby: jnp.ndarray  # [n, DOTS, BW] int32 blockers of a waiting proposal
+    waiting: jnp.ndarray  # [n, DOTS] bool — MProposeAck still unsent
+    # coordinator fast-quorum aggregation (QuorumClocks)
+    qc_count: jnp.ndarray  # [n, DOTS] int32
+    qc_clock: jnp.ndarray  # [n, DOTS] int32 max clock
+    qc_deps: jnp.ndarray  # [n, DOTS, BW] int32 union deps
+    qc_ok: jnp.ndarray  # [n, DOTS] bool (and of oks)
+    qc_decided: jnp.ndarray  # [n, DOTS] bool
+    # coordinator retry aggregation (QuorumRetries)
+    qr_count: jnp.ndarray  # [n, DOTS] int32
+    qr_deps: jnp.ndarray  # [n, DOTS, BW] int32
+    qr_decided: jnp.ndarray  # [n, DOTS] bool
+    # buffered MRetry / MCommit that overtook the MPropose (caesar.rs:37-42)
+    bufr_valid: jnp.ndarray  # [n, DOTS] bool
+    bufr_clock: jnp.ndarray  # [n, DOTS] int32
+    bufr_from: jnp.ndarray  # [n, DOTS] int32
+    bufr_deps: jnp.ndarray  # [n, DOTS, BW] int32
+    bufc_valid: jnp.ndarray  # [n, DOTS] bool
+    bufc_clock: jnp.ndarray  # [n, DOTS] int32
+    bufc_from: jnp.ndarray  # [n, DOTS] int32
+    bufc_deps: jnp.ndarray  # [n, DOTS, BW] int32
+    # GC (BasicGCTrack over cumulative executed bitmaps)
+    gcexec: jnp.ndarray  # [n, n, BW] int32 executed bitmap reported per sender
+    stable_bm: jnp.ndarray  # [n, BW] int32 stable (executed-at-all) dots
+    stable_count: jnp.ndarray  # [n] int32
+    fast_count: jnp.ndarray  # [n] int32
+    slow_count: jnp.ndarray  # [n] int32
+    commit_count: jnp.ndarray  # [n] int32
+
+
+def make_protocol(
+    n: int,
+    keys_per_command: int,
+    max_seq: int,
+    wait_condition: bool = True,
+) -> ProtocolDef:
+    """Build the Caesar ProtocolDef.
+
+    `max_seq` must equal the SimSpec's dot window (dep bitmaps are sized by
+    it at trace time). `wait_condition` gates the blocking behavior exactly
+    like `Config::caesar_wait_condition`.
+    """
+    assert n <= CLOCK_PIDS
+    KPC = keys_per_command
+    DOTS = n * max_seq
+    BW = bm_words(DOTS)
+    MSG_W = 3 + BW
+    MAX_OUT = 3
+    MAX_EXEC = 1
+    exdef = pred_executor.make_executor(n, max_seq)
+    EW = exdef.exec_width
+
+    def init(spec, env):
+        assert spec.dots == DOTS, (
+            f"Caesar compiled for max_seq={max_seq}, spec has {spec.max_seq}"
+        )
+        z = lambda *shape: jnp.zeros(shape, jnp.int32)
+        b = lambda *shape: jnp.zeros(shape, jnp.bool_)
+        return CaesarState(
+            clk_cur=jnp.arange(n, dtype=jnp.int32),  # seq 0 composite per p
+            status=z(n, DOTS),
+            clock_of=z(n, DOTS),
+            in_clocks=b(n, DOTS),
+            deps=z(n, DOTS, BW),
+            blockedby=z(n, DOTS, BW),
+            waiting=b(n, DOTS),
+            qc_count=z(n, DOTS),
+            qc_clock=z(n, DOTS),
+            qc_deps=z(n, DOTS, BW),
+            qc_ok=jnp.ones((n, DOTS), jnp.bool_),
+            qc_decided=b(n, DOTS),
+            qr_count=z(n, DOTS),
+            qr_deps=z(n, DOTS, BW),
+            qr_decided=b(n, DOTS),
+            bufr_valid=b(n, DOTS),
+            bufr_clock=z(n, DOTS),
+            bufr_from=z(n, DOTS),
+            bufr_deps=z(n, DOTS, BW),
+            bufc_valid=b(n, DOTS),
+            bufc_clock=z(n, DOTS),
+            bufc_from=z(n, DOTS),
+            bufc_deps=z(n, DOTS, BW),
+            gcexec=z(n, n, BW),
+            stable_bm=z(n, BW),
+            stable_count=z(n),
+            fast_count=z(n),
+            slow_count=z(n),
+            commit_count=z(n),
+        )
+
+    # ------------------------------------------------------------------
+    # clock + predecessor helpers (common/pred/clocks)
+    # ------------------------------------------------------------------
+
+    def _clock_next(st: CaesarState, p, enable):
+        """KeyClocks::clock_next — (seq+1, p), strictly above all seen."""
+        seq = st.clk_cur[p] // CLOCK_PIDS + 1
+        new = seq * CLOCK_PIDS + p
+        st = st._replace(
+            clk_cur=st.clk_cur.at[p].set(
+                jnp.where(jnp.asarray(enable), new, st.clk_cur[p])
+            )
+        )
+        return st, new
+
+    def _clock_join(st: CaesarState, p, other):
+        return st._replace(clk_cur=st.clk_cur.at[p].max(other))
+
+    def _conflicts(ctx, p, dot):
+        """[DOTS] mask of registered commands sharing a key with `dot`'s
+        command, excluding `dot` itself (`KeyClocks::predecessors` scan)."""
+        keys = ctx.cmds.keys[dot]  # [KPC]
+        allk = ctx.cmds.keys  # [DOTS, KPC]
+        hit = jnp.zeros((DOTS,), jnp.bool_)
+        for i in range(KPC):
+            hit = hit | (allk == keys[i]).any(axis=1)
+        return hit & (jnp.arange(DOTS) != dot)
+
+    # ------------------------------------------------------------------
+    # handlers
+    # ------------------------------------------------------------------
+
+    def submit(ctx, st: CaesarState, p, dot, now):
+        st, clock = _clock_next(st, p, True)
+        ob = outbox_row(
+            empty_outbox(MAX_OUT, MSG_W), 0,
+            jnp.bool_(True), ctx.env.all_mask, MPROPOSE, [dot, clock],
+        )
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def _flush_rows(st: CaesarState, ob, p, dot, enable):
+        """Re-emit buffered MRetry/MCommit as 0-delay self-messages once the
+        MPropose payload has arrived (caesar.rs:497-510)."""
+        me = jnp.int32(1) << p
+        ob = outbox_row(
+            ob, 1, enable & st.bufr_valid[p, dot], me, MRETRY,
+            [dot, st.bufr_clock[p, dot], st.bufr_from[p, dot]]
+            + list(st.bufr_deps[p, dot]),
+        )
+        ob = outbox_row(
+            ob, 2, enable & st.bufc_valid[p, dot], me, MCOMMIT,
+            [dot, st.bufc_clock[p, dot], st.bufc_from[p, dot]]
+            + list(st.bufc_deps[p, dot]),
+        )
+        st = st._replace(
+            bufr_valid=st.bufr_valid.at[p, dot].set(
+                st.bufr_valid[p, dot] & ~enable
+            ),
+            bufc_valid=st.bufc_valid.at[p, dot].set(
+                st.bufc_valid[p, dot] & ~enable
+            ),
+        )
+        return st, ob
+
+    def h_mpropose(ctx, st: CaesarState, p, src, payload, now):
+        dot, rclock = payload[0], payload[1]
+        st = _clock_join(st, p, rclock)
+        active = st.status[p, dot] == START
+
+        conflict = _conflicts(ctx, p, dot) & st.in_clocks[p]
+        lower = conflict & (st.clock_of[p] < rclock)
+        higher = conflict & (st.clock_of[p] > rclock)
+        deps_bm = bm_pack(lower, BW)
+
+        # register under the proposed clock (update_clock, caesar.rs:314-318)
+        st = st._replace(
+            status=st.status.at[p, dot].set(
+                jnp.where(active, PROPOSE, st.status[p, dot])
+            ),
+            clock_of=st.clock_of.at[p, dot].set(
+                jnp.where(active, rclock, st.clock_of[p, dot])
+            ),
+            in_clocks=st.in_clocks.at[p, dot].set(st.in_clocks[p, dot] | active),
+            deps=st.deps.at[p, dot].set(
+                jnp.where(active, deps_bm, st.deps[p, dot])
+            ),
+        )
+
+        # wait-condition triage of the blockers (caesar.rs:327-440)
+        b_safe = (st.status[p] == ACCEPT) | (st.status[p] == COMMIT)
+        # deps[p, b] contains `dot`? (bm_get over the blocker axis)
+        contains = jax.vmap(lambda bm: bm_get(bm, dot))(st.deps[p]) == 1
+        stable = bm_unpack(st.stable_bm[p], DOTS)
+        if wait_condition:
+            reject = active & (higher & b_safe & ~contains & ~stable).any()
+            remaining = higher & ~b_safe & ~stable
+            wait = active & ~reject & remaining.any()
+        else:
+            reject = active & higher.any()
+            remaining = jnp.zeros((DOTS,), jnp.bool_)
+            wait = jnp.bool_(False)
+        accept = active & ~reject & ~wait
+
+        # REJECT: fresh clock + full predecessor set in the nack
+        # (reject_command, caesar.rs:1120-1146 — the registered clock stays)
+        st, new_clock = _clock_next(st, p, reject)
+        nack_deps = bm_pack(conflict & st.in_clocks[p], BW)
+
+        st = st._replace(
+            status=st.status.at[p, dot].set(
+                jnp.where(reject, REJECT, st.status[p, dot])
+            ),
+            blockedby=st.blockedby.at[p, dot].set(
+                jnp.where(wait, bm_pack(remaining, BW), st.blockedby[p, dot])
+            ),
+            waiting=st.waiting.at[p, dot].set(st.waiting[p, dot] | wait),
+        )
+
+        ack_clock = jnp.where(reject, new_clock, rclock)
+        ack_deps = jnp.where(reject, nack_deps, deps_bm)
+        ob = outbox_row(
+            empty_outbox(MAX_OUT, MSG_W), 0,
+            accept | reject, jnp.int32(1) << src, MPROPOSEACK,
+            [dot, ack_clock, accept.astype(jnp.int32)] + list(ack_deps),
+        )
+        st, ob = _flush_rows(st, ob, p, dot, active)
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def h_mproposeack(ctx, st: CaesarState, p, src, payload, now):
+        dot, clock, ok = payload[0], payload[1], payload[2] == 1
+        rdeps = payload[3 : 3 + BW]
+        live = (
+            ((st.status[p, dot] == PROPOSE) | (st.status[p, dot] == REJECT))
+            & ~st.qc_decided[p, dot]
+        )
+        count = st.qc_count[p, dot] + live.astype(jnp.int32)
+        agg_ok = st.qc_ok[p, dot] & (ok | ~live)
+        st = st._replace(
+            qc_count=st.qc_count.at[p, dot].set(count),
+            qc_clock=st.qc_clock.at[p, dot].max(jnp.where(live, clock, 0)),
+            qc_deps=st.qc_deps.at[p, dot].set(
+                st.qc_deps[p, dot] | jnp.where(live, rdeps, 0)
+            ),
+            qc_ok=st.qc_ok.at[p, dot].set(agg_ok),
+        )
+        # all(): full fast quorum, or a not-ok after a majority (quorum.rs:60-70)
+        all_in = live & (
+            (count == ctx.env.fq_size) | (~agg_ok & (count >= ctx.env.wq_size))
+        )
+        fast = all_in & agg_ok
+        slow = all_in & ~agg_ok
+        st = st._replace(
+            qc_decided=st.qc_decided.at[p, dot].set(st.qc_decided[p, dot] | all_in),
+            fast_count=st.fast_count.at[p].add(fast.astype(jnp.int32)),
+            slow_count=st.slow_count.at[p].add(slow.astype(jnp.int32)),
+        )
+        ob = outbox_row(
+            empty_outbox(MAX_OUT, MSG_W), 0,
+            all_in, ctx.env.all_mask,
+            jnp.where(fast, MCOMMIT, MRETRY),
+            [dot, st.qc_clock[p, dot], p] + list(st.qc_deps[p, dot]),
+        )
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def _unblock_row(st: CaesarState, ob, row, p, enable):
+        """Schedule a 0-delay self `MUNBLOCK` scan (try_to_unblock)."""
+        pending = st.waiting[p].any()
+        return outbox_row(
+            ob, row, enable & pending, jnp.int32(1) << p, MUNBLOCK, [],
+        )
+
+    def h_mcommit(ctx, st: CaesarState, p, src, payload, now):
+        dot, clock, mfrom = payload[0], payload[1], payload[2]
+        rdeps = payload[3 : 3 + BW]
+        st = _clock_join(st, p, clock)
+        is_start = st.status[p, dot] == START
+        done = st.status[p, dot] == COMMIT
+        can = ~is_start & ~done
+
+        # buffer if the MPropose hasn't arrived yet (caesar.rs:630-636)
+        st = st._replace(
+            bufc_valid=st.bufc_valid.at[p, dot].set(st.bufc_valid[p, dot] | is_start),
+            bufc_clock=st.bufc_clock.at[p, dot].set(
+                jnp.where(is_start, clock, st.bufc_clock[p, dot])
+            ),
+            bufc_from=st.bufc_from.at[p, dot].set(
+                jnp.where(is_start, mfrom, st.bufc_from[p, dot])
+            ),
+            bufc_deps=st.bufc_deps.at[p, dot].set(
+                jnp.where(is_start, rdeps, st.bufc_deps[p, dot])
+            ),
+        )
+
+        # a command may end up depending on itself — drop the self-dep before
+        # the executor sees it (caesar.rs:666-669)
+        rdeps = bm_clear(rdeps, dot)
+
+        st = st._replace(
+            status=st.status.at[p, dot].set(jnp.where(can, COMMIT, st.status[p, dot])),
+            clock_of=st.clock_of.at[p, dot].set(
+                jnp.where(can, clock, st.clock_of[p, dot])
+            ),
+            deps=st.deps.at[p, dot].set(jnp.where(can, rdeps, st.deps[p, dot])),
+            commit_count=st.commit_count.at[p].add(can.astype(jnp.int32)),
+            # a waiting proposal decided without our ack leaves the wait set
+            waiting=st.waiting.at[p, dot].set(st.waiting[p, dot] & ~can),
+        )
+        execout = ExecOut(
+            valid=jnp.broadcast_to(can, (MAX_EXEC,)),
+            info=jnp.concatenate([dot[None], clock[None], rdeps])[None, :],
+        )
+        ob = _unblock_row(st, empty_outbox(MAX_OUT, MSG_W), 0, p, can)
+        return st, ob, execout
+
+    def h_mretry(ctx, st: CaesarState, p, src, payload, now):
+        dot, clock, mfrom = payload[0], payload[1], payload[2]
+        rdeps = payload[3 : 3 + BW]
+        st = _clock_join(st, p, clock)
+        is_start = st.status[p, dot] == START
+        done = st.status[p, dot] == COMMIT
+        can = ~is_start & ~done
+
+        st = st._replace(
+            bufr_valid=st.bufr_valid.at[p, dot].set(st.bufr_valid[p, dot] | is_start),
+            bufr_clock=st.bufr_clock.at[p, dot].set(
+                jnp.where(is_start, clock, st.bufr_clock[p, dot])
+            ),
+            bufr_from=st.bufr_from.at[p, dot].set(
+                jnp.where(is_start, mfrom, st.bufr_from[p, dot])
+            ),
+            bufr_deps=st.bufr_deps.at[p, dot].set(
+                jnp.where(is_start, rdeps, st.bufr_deps[p, dot])
+            ),
+        )
+
+        # ACCEPT with the aggregated clock/deps (caesar.rs:735-744)
+        st = st._replace(
+            status=st.status.at[p, dot].set(jnp.where(can, ACCEPT, st.status[p, dot])),
+            clock_of=st.clock_of.at[p, dot].set(
+                jnp.where(can, clock, st.clock_of[p, dot])
+            ),
+            deps=st.deps.at[p, dot].set(jnp.where(can, rdeps, st.deps[p, dot])),
+            waiting=st.waiting.at[p, dot].set(st.waiting[p, dot] & ~can),
+        )
+        # reply with deps extended by our own lower-clock conflicts
+        conflict = _conflicts(ctx, p, dot) & st.in_clocks[p]
+        mine = bm_pack(conflict & (st.clock_of[p] < clock), BW)
+        ob = outbox_row(
+            empty_outbox(MAX_OUT, MSG_W), 0,
+            can, jnp.int32(1) << mfrom, MRETRYACK,
+            [dot, p, jnp.int32(0)] + list(rdeps | mine),
+        )
+        ob = _unblock_row(st, ob, 1, p, can)
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def h_mretryack(ctx, st: CaesarState, p, src, payload, now):
+        dot = payload[0]
+        rdeps = payload[3 : 3 + BW]
+        live = (st.status[p, dot] == ACCEPT) & ~st.qr_decided[p, dot]
+        count = st.qr_count[p, dot] + live.astype(jnp.int32)
+        st = st._replace(
+            qr_count=st.qr_count.at[p, dot].set(count),
+            qr_deps=st.qr_deps.at[p, dot].set(
+                st.qr_deps[p, dot] | jnp.where(live, rdeps, 0)
+            ),
+        )
+        all_in = live & (count == ctx.env.wq_size)
+        st = st._replace(
+            qr_decided=st.qr_decided.at[p, dot].set(st.qr_decided[p, dot] | all_in)
+        )
+        ob = outbox_row(
+            empty_outbox(MAX_OUT, MSG_W), 0,
+            all_in, ctx.env.all_mask, MCOMMIT,
+            [dot, st.clock_of[p, dot], p] + list(st.qr_deps[p, dot]),
+        )
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def h_munblock(ctx, st: CaesarState, p, src, payload, now):
+        """One try_to_unblock scan: re-evaluate every waiting proposal
+        against the current dot table, persist newly-ignorable blockers,
+        decide (accept/reject) the dot-minimal decidable one, and reschedule
+        while more decisions are pending."""
+        dots = jnp.arange(DOTS, dtype=jnp.int32)
+        waitw = st.waiting[p] & (st.status[p] == PROPOSE)  # [w]
+        bits = bm_unpack(st.blockedby[p], DOTS)  # [w, b]
+        b_safe = (st.status[p] == ACCEPT) | (st.status[p] == COMMIT)  # [b]
+        contains = bm_unpack(st.deps[p], DOTS).T  # [w, b]: deps[b] has w
+        stable = bm_unpack(st.stable_bm[p], DOTS)  # [b]
+        ign = bits & b_safe[None, :] & (contains | stable[None, :])
+        rej = waitw & (bits & b_safe[None, :] & ~contains & ~stable[None, :]).any(axis=1)
+        newbits = bits & ~ign
+        acc = waitw & ~rej & ~newbits.any(axis=1)
+
+        # persist ignorable-blocker clearing for every waiting proposal
+        newbm = jax.vmap(lambda m: bm_pack(m, BW))(newbits)
+        st = st._replace(
+            blockedby=st.blockedby.at[p].set(
+                jnp.where(waitw[:, None], newbm, st.blockedby[p])
+            )
+        )
+
+        dec = rej | acc
+        ndec = dec.sum()
+        w = jnp.where(dec, dots, jnp.int32(2**30)).min()
+        wc = jnp.clip(w, 0, DOTS - 1)
+        has = ndec > 0
+        do_acc = has & acc[wc]
+        do_rej = has & rej[wc]
+
+        st, new_clock = _clock_next(st, p, do_rej)
+        conflict = _conflicts(ctx, p, wc) & st.in_clocks[p]
+        nack_deps = bm_pack(conflict, BW)
+        st = st._replace(
+            status=st.status.at[p, wc].set(
+                jnp.where(do_rej, REJECT, st.status[p, wc])
+            ),
+            waiting=st.waiting.at[p, wc].set(st.waiting[p, wc] & ~has),
+        )
+        ack_clock = jnp.where(do_rej, new_clock, st.clock_of[p, wc])
+        ack_deps = jnp.where(do_rej, nack_deps, st.deps[p, wc])
+        coord = dot_proc(wc, max_seq)
+        ob = outbox_row(
+            empty_outbox(MAX_OUT, MSG_W), 0,
+            do_acc | do_rej, jnp.int32(1) << coord, MPROPOSEACK,
+            [wc, ack_clock, do_acc.astype(jnp.int32)] + list(ack_deps),
+        )
+        # more decisions pending -> rescan at the same simulated time
+        ob = outbox_row(
+            ob, 1, ndec > 1, jnp.int32(1) << p, MUNBLOCK, [],
+        )
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def h_mgc(ctx, st: CaesarState, p, src, payload, now):
+        """Join a peer's executed set; dots executed at all n processes are
+        stable: count them and drop them from the key clocks (`gc_command`)."""
+        row = st.gcexec[p, src] | payload[:BW]
+        gcexec = st.gcexec.at[p, src].set(row)
+        allrep = gcexec[p, 0]
+        for i in range(1, n):
+            allrep = allrep & gcexec[p, i]
+        new = allrep & ~st.stable_bm[p]
+        gained = bm_count(new)
+        st = st._replace(
+            gcexec=gcexec,
+            stable_bm=st.stable_bm.at[p].set(st.stable_bm[p] | new),
+            stable_count=st.stable_count.at[p].add(gained),
+            in_clocks=st.in_clocks.at[p].set(
+                st.in_clocks[p] & ~bm_unpack(new, DOTS)
+            ),
+        )
+        # newly-stable blockers may unblock waiting proposals
+        ob = _unblock_row(st, empty_outbox(MAX_OUT, MSG_W), 0, p, gained > 0)
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def handle(ctx, st, p, src, kind, payload, now):
+        branches = [
+            functools.partial(h, ctx)
+            for h in (
+                h_mpropose,
+                h_mproposeack,
+                h_mcommit,
+                h_mretry,
+                h_mretryack,
+                h_munblock,
+                h_mgc,
+            )
+        ]
+        return jax.lax.switch(kind, branches, st, p, src, payload, now)
+
+    def handle_executed(ctx, st: CaesarState, p, info, now):
+        """Fold the executor's executed set into our own GC row
+        (`Protocol::handle_executed`, caesar.rs:194-213)."""
+        st = st._replace(
+            gcexec=st.gcexec.at[p, p].set(st.gcexec[p, p] | info[:BW])
+        )
+        return st, empty_outbox(MAX_OUT, MSG_W)
+
+    def periodic(ctx, st: CaesarState, p, kind, now):
+        all_but_me = ctx.env.all_mask & ~(jnp.int32(1) << p)
+        ob = outbox_row(
+            empty_outbox(1, MSG_W), 0,
+            jnp.bool_(True), all_but_me, MGC, list(st.gcexec[p, p]),
+        )
+        return st, ob
+
+    def metrics(st: CaesarState):
+        return {
+            "stable": st.stable_count,
+            "commits": st.commit_count,
+            "fast": st.fast_count,
+            "slow": st.slow_count,
+        }
+
+    return ProtocolDef(
+        name="caesar",
+        n_msg_kinds=N_KINDS,
+        msg_width=MSG_W,
+        max_out=MAX_OUT,
+        max_exec=MAX_EXEC,
+        executor=exdef,
+        init=init,
+        submit=submit,
+        handle=handle,
+        periodic_events=(("garbage_collection", lambda cfg: cfg.gc_interval_ms),),
+        periodic=periodic,
+        handle_executed=handle_executed,
+        quorum_sizes=lambda cfg: cfg.caesar_quorum_sizes() + (0,),
+        leaderless=True,
+        metrics=metrics,
+    )

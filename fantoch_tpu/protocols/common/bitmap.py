@@ -1,0 +1,73 @@
+"""Packed dot-set bitmaps (Caesar's `CaesarDeps` / executed sets on device).
+
+The reference represents Caesar dependency sets as `HashSet<Dot>`
+(`fantoch_ps/src/protocol/common/pred/mod.rs:15` `CaesarDeps`). Caesar dep
+sets are unbounded (all conflicting lower-clock commands), so the fixed-width
+slot rows used by Atlas/EPaxos (`common/deps.py`) don't fit. Instead, dot
+sets ride messages and state as dense bitmaps over the flat dot window,
+packed 16 bits per int32 word — 16 (not 32) so every word stays a small
+non-negative int32 and set algebra is plain integer ops, safe inside the
+engine's int32 message payloads.
+
+All helpers are shape-static and traceable.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+BITS = 16
+MASK = (1 << BITS) - 1
+
+
+def bm_words(dots: int) -> int:
+    """Words needed for a `dots`-wide bitmap."""
+    return (dots + BITS - 1) // BITS
+
+
+def bm_zeros(bw: int) -> jnp.ndarray:
+    return jnp.zeros((bw,), jnp.int32)
+
+
+def bm_pack(mask: jnp.ndarray, bw: int) -> jnp.ndarray:
+    """Pack a [DOTS] bool mask into [bw] int32 words."""
+    dots = mask.shape[0]
+    pad = bw * BITS - dots
+    m = jnp.concatenate([mask, jnp.zeros((pad,), mask.dtype)]) if pad else mask
+    m = m.reshape(bw, BITS).astype(jnp.int32)
+    weights = (jnp.int32(1) << jnp.arange(BITS, dtype=jnp.int32))
+    return (m * weights[None, :]).sum(axis=1)
+
+
+def bm_unpack(bm: jnp.ndarray, dots: int) -> jnp.ndarray:
+    """Unpack [..., bw] words into a [..., dots] bool mask."""
+    idx = jnp.arange(dots, dtype=jnp.int32)
+    word = idx // BITS
+    bit = idx % BITS
+    return ((jnp.take(bm, word, axis=-1) >> bit) & 1).astype(jnp.bool_)
+
+
+def bm_get(bm: jnp.ndarray, d) -> jnp.ndarray:
+    """Test membership of dot `d` (traced scalar)."""
+    return (bm[d // BITS] >> (d % BITS)) & 1
+
+
+def bm_set(bm: jnp.ndarray, d, enable=True) -> jnp.ndarray:
+    word = d // BITS
+    new = bm[word] | (jnp.int32(1) << (d % BITS))
+    return bm.at[word].set(jnp.where(jnp.asarray(enable), new, bm[word]))
+
+
+def bm_clear(bm: jnp.ndarray, d, enable=True) -> jnp.ndarray:
+    word = d // BITS
+    new = bm[word] & ~(jnp.int32(1) << (d % BITS))
+    return bm.at[word].set(jnp.where(jnp.asarray(enable), new, bm[word]))
+
+
+def bm_count(bm: jnp.ndarray) -> jnp.ndarray:
+    """Popcount over the last axis."""
+    return lax.population_count(bm.astype(jnp.uint32)).astype(jnp.int32).sum(axis=-1)
+
+
+def bm_any(bm: jnp.ndarray) -> jnp.ndarray:
+    return (bm != 0).any(axis=-1)

@@ -1,0 +1,100 @@
+"""End-to-end golden tests for Caesar + PredecessorsExecutor.
+
+Mirrors the reference's Caesar sim tests
+(`fantoch_ps/src/protocol/mod.rs:512-556`): n=3 f=1 and n=5 f=2, with the
+wait condition on and off, under 50% conflicts. The reference pins no
+fast/slow-path counts for Caesar (`sim_caesar_*` ignore `_slow_paths`); the
+checks are commit/execution completeness, GC completeness, and cross-replica
+execution-order agreement.
+"""
+import jax
+import numpy as np
+
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.planet import Planet
+from fantoch_tpu.core.workload import KeyGen, Workload
+from fantoch_tpu.engine import lockstep, setup, summary
+from fantoch_tpu.protocols import caesar as caesar_proto
+
+COMMANDS_PER_CLIENT = 10
+PROCESS_REGIONS = ["asia-east1", "us-central1", "us-west1", "us-west2", "europe-west2"]
+CLIENT_REGIONS = ["us-west1", "us-west2"]
+
+
+def run(
+    n: int,
+    f: int,
+    wait_condition: bool,
+    conflict_rate: int = 50,
+    clients_per_region: int = 2,
+    keys_per_command: int = 1,
+    reorder: bool = False,
+    seed: int = 0,
+):
+    planet = Planet.new()
+    config = Config(
+        n=n, f=f, gc_interval_ms=50, caesar_wait_condition=wait_condition
+    )
+    workload = Workload(
+        shard_count=1,
+        key_gen=KeyGen.conflict_pool(conflict_rate=conflict_rate, pool_size=1),
+        keys_per_command=keys_per_command,
+        commands_per_client=COMMANDS_PER_CLIENT,
+        read_only_percentage=0,
+    )
+    C = len(CLIENT_REGIONS) * clients_per_region
+    max_seq = C * COMMANDS_PER_CLIENT
+    pdef = caesar_proto.make_protocol(
+        n, workload.keys_per_command, max_seq, wait_condition=wait_condition
+    )
+    spec = setup.build_spec(
+        config, workload, pdef, n_clients=C, n_client_groups=len(CLIENT_REGIONS),
+        max_seq=max_seq, extra_ms=2000, max_steps=5_000_000, reorder=reorder,
+    )
+    placement = setup.Placement(PROCESS_REGIONS[:n], CLIENT_REGIONS, clients_per_region)
+    env = setup.build_env(spec, config, planet, placement, workload, pdef, seed=seed)
+    st = jax.jit(lockstep.make_run(spec, pdef, workload))(env)
+    st = jax.tree_util.tree_map(np.asarray, st)
+    summary.check_sim_health(st)
+    metrics = summary.protocol_metrics(st, pdef)
+    return st, metrics, spec
+
+
+def check(st, metrics, spec):
+    total = spec.n_clients * COMMANDS_PER_CLIENT
+    assert (metrics["commits"] == total).all(), metrics["commits"]
+    # every proposal decided exactly once at its coordinator
+    assert (metrics["fast"] + metrics["slow"]).sum() == total, metrics
+    # the pred executor counts executions per command (like graph, unlike
+    # table's per-key-entry count)
+    assert (st.exec.executed_count == total).all(), st.exec.executed_count
+    # GC completeness: every dot became stable at every process
+    assert (metrics["stable"] == total).all(), metrics["stable"]
+    # cross-replica execution order agreement per key
+    assert (st.exec.order_cnt == st.exec.order_cnt[0]).all()
+    assert (st.exec.order_hash == st.exec.order_hash[0]).all(), st.exec.order_hash
+
+
+def test_caesar_wait_n3_f1():
+    st, metrics, spec = run(3, 1, wait_condition=True)
+    check(st, metrics, spec)
+
+
+def test_caesar_no_wait_n3_f1():
+    st, metrics, spec = run(3, 1, wait_condition=False)
+    check(st, metrics, spec)
+
+
+def test_caesar_wait_n5_f2():
+    st, metrics, spec = run(5, 2, wait_condition=True)
+    check(st, metrics, spec)
+
+
+def test_caesar_no_wait_n5_f2():
+    st, metrics, spec = run(5, 2, wait_condition=False)
+    check(st, metrics, spec)
+
+
+def test_caesar_wait_n3_f1_reorder():
+    st, metrics, spec = run(3, 1, wait_condition=True, reorder=True, seed=5)
+    check(st, metrics, spec)
