@@ -1,0 +1,206 @@
+"""Command-line interface.
+
+The analogue of the reference's binaries (reference: `fantoch_ps/src/bin/*` —
+per-protocol servers + simulation sweep, `fantoch_bote/src/main.rs` planner,
+`fantoch_plot` plot driver), collapsed into one entry point:
+
+    python -m fantoch_tpu sim    --protocol tempo --n 3 --f 1 ...
+    python -m fantoch_tpu sweep  --protocols tempo,atlas --fs 1,2 ...
+    python -m fantoch_tpu plot   --results results --out plots ...
+    python -m fantoch_tpu bote   --ns 3,5 ...
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _csv(s: str):
+    return [x for x in s.split(",") if x]
+
+
+def _icsv(s: str):
+    return [int(x) for x in _csv(s)]
+
+
+def cmd_sim(args) -> int:
+    from .exp.harness import Point, run_grid
+    from .plot.db import ResultsDB
+    from .plot.plots import sim_output_stats
+
+    pt = Point(
+        protocol=args.protocol,
+        n=args.n,
+        f=args.f,
+        clients_per_region=args.clients,
+        conflict_rate=args.conflict,
+        keys_per_command=args.keys_per_command,
+        commands_per_client=args.commands,
+        read_only_percentage=args.read_only,
+        seed=args.seed,
+    )
+    dirs = run_grid(
+        [pt],
+        process_regions=_csv(args.process_regions) if args.process_regions else None,
+        client_regions=_csv(args.client_regions) if args.client_regions else None,
+        results_root=args.results,
+        name=f"sim_{args.protocol}",
+        verbose=args.verbose,
+    )
+    db = ResultsDB.load(args.results)
+    # print only this invocation's run (the root may hold older results)
+    for stats in sim_output_stats(db.find(**pt.search())):
+        print(json.dumps(stats))
+    print(f"results: {dirs[0]}", file=sys.stderr)
+    return 0
+
+
+def cmd_sweep(args) -> int:
+    from .exp.harness import Point, run_grid
+
+    points = []
+    for proto in _csv(args.protocols):
+        # EPaxos ignores the configured f (always tolerates a minority):
+        # sweep it at one representative f instead of once per f value
+        fs = _icsv(args.fs)[:1] if proto == "epaxos" else _icsv(args.fs)
+        for f in fs:
+            for conflict in _icsv(args.conflicts):
+                for clients in _icsv(args.clients):
+                    points.append(
+                        Point(
+                            protocol=proto,
+                            n=args.n,
+                            f=f,
+                            clients_per_region=clients,
+                            conflict_rate=conflict,
+                            commands_per_client=args.commands,
+                            seed=args.seed,
+                        )
+                    )
+    mesh = None
+    if args.mesh:
+        import jax
+        import numpy as np
+
+        mesh = jax.sharding.Mesh(np.array(jax.devices()), ("configs",))
+    dirs = run_grid(
+        points,
+        process_regions=_csv(args.process_regions) if args.process_regions else None,
+        client_regions=_csv(args.client_regions) if args.client_regions else None,
+        results_root=args.results,
+        name=args.name,
+        mesh=mesh,
+        chunk_steps=args.chunk_steps or None,
+        verbose=args.verbose,
+    )
+    print(json.dumps({"points": len(points), "dirs": dirs}))
+    return 0
+
+
+def cmd_plot(args) -> int:
+    from .plot.db import ResultsDB
+    from .plot.plots import (
+        cdf_plot,
+        fast_path_plot,
+        sim_output_stats,
+        throughput_latency_plot,
+    )
+
+    db = ResultsDB.load(args.results)
+    if not len(db):
+        print(f"no results under {args.results}", file=sys.stderr)
+        return 1
+    os.makedirs(args.out, exist_ok=True)
+    protos = sorted({e.search.get("protocol") for e in db})
+    series = {p: db.find(protocol=p) for p in protos}
+    made = [
+        cdf_plot(list(db), os.path.join(args.out, "cdf.png")),
+        throughput_latency_plot(
+            series, os.path.join(args.out, "throughput_latency.png")
+        ),
+    ]
+    if any("conflict" in e.search for e in db):
+        made.append(
+            fast_path_plot(
+                series, "conflict", os.path.join(args.out, "fast_path.png")
+            )
+        )
+    for stats in sim_output_stats(list(db)):
+        print(json.dumps(stats))
+    print(json.dumps({"figures": made}))
+    return 0
+
+
+def cmd_bote(args) -> int:
+    from .core.planet import Planet
+    from .planner.bote import Bote, RankingParams, Search
+
+    planet = Planet.new()
+    regions = planet.regions()
+    clients = _csv(args.clients) if args.clients else regions
+    search = Search(Bote(planet, regions), _icsv(args.ns), clients)
+    search.compute()
+    out = {}
+    for n in _icsv(args.ns):
+        ranked = search.rank(n, RankingParams())
+        out[n] = ranked[: args.top]
+    print(json.dumps(out))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="fantoch_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    ps = sub.add_parser("sim", help="run one configuration, print latency stats")
+    ps.add_argument("--protocol", required=True)
+    ps.add_argument("--n", type=int, default=3)
+    ps.add_argument("--f", type=int, default=1)
+    ps.add_argument("--clients", type=int, default=2)
+    ps.add_argument("--conflict", type=int, default=0)
+    ps.add_argument("--keys-per-command", type=int, default=1)
+    ps.add_argument("--commands", type=int, default=100)
+    ps.add_argument("--read-only", type=int, default=0)
+    ps.add_argument("--seed", type=int, default=0)
+    ps.add_argument("--process-regions", default="")
+    ps.add_argument("--client-regions", default="")
+    ps.add_argument("--results", default="results")
+    ps.add_argument("--verbose", action="store_true")
+    ps.set_defaults(fn=cmd_sim)
+
+    pw = sub.add_parser("sweep", help="run a protocol x config grid")
+    pw.add_argument("--protocols", default="tempo,atlas,epaxos")
+    pw.add_argument("--n", type=int, default=5)
+    pw.add_argument("--fs", default="1,2")
+    pw.add_argument("--conflicts", default="2,10,50,100")
+    pw.add_argument("--clients", default="1,2,4")
+    pw.add_argument("--commands", type=int, default=100)
+    pw.add_argument("--seed", type=int, default=0)
+    pw.add_argument("--process-regions", default="")
+    pw.add_argument("--client-regions", default="")
+    pw.add_argument("--results", default="results")
+    pw.add_argument("--name", default="sweep")
+    pw.add_argument("--mesh", action="store_true", help="shard over all devices")
+    pw.add_argument("--chunk-steps", type=int, default=0)
+    pw.add_argument("--verbose", action="store_true")
+    pw.set_defaults(fn=cmd_sweep)
+
+    pp = sub.add_parser("plot", help="figures + stats from a results root")
+    pp.add_argument("--results", default="results")
+    pp.add_argument("--out", default="plots")
+    pp.set_defaults(fn=cmd_plot)
+
+    pb = sub.add_parser("bote", help="closed-form config-space planner search")
+    pb.add_argument("--ns", default="3,5")
+    pb.add_argument("--clients", default="")
+    pb.add_argument("--top", type=int, default=5)
+    pb.set_defaults(fn=cmd_bote)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

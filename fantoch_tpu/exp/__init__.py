@@ -1,0 +1,1 @@
+from .harness import Point, make_protocol_def, run_grid  # noqa: F401

@@ -1,0 +1,246 @@
+"""Experiment harness: protocol × config grids → device sweep → results dir.
+
+The TPU-native equivalent of `fantoch_exp` (reference:
+`fantoch_exp/src/bench.rs:43` `bench_experiment` — the loop over
+(protocol, config, clients) that launches runs and pulls metrics into
+timestamped result dirs) fused with the rayon sweep binary
+(`fantoch_ps/src/bin/simulation.rs:140-216`). There are no remote machines
+to orchestrate: a grid point is an `Env` row, a "deployment" is a vmapped
+shape bucket, and a multi-chip "testbed" is a `jax.sharding.Mesh` over which
+the batch axis is sharded (`engine/sweep.py`).
+
+Grid points are bucketed by everything that affects compiled shapes
+(protocol, n, client count, keys/commands per client); within a bucket f,
+conflict rate, read-only %, placement and seed vary freely as Env data.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..core.config import Config
+from ..core.planet import Planet
+from ..core.workload import KeyGen, Workload
+from ..engine import setup, summary, sweep
+from ..engine.types import ProtocolDef
+from ..plot import db as results_db
+from ..protocols import atlas as atlas_proto
+from ..protocols import basic as basic_proto
+from ..protocols import caesar as caesar_proto
+from ..protocols import epaxos as epaxos_proto
+from ..protocols import fpaxos as fpaxos_proto
+from ..protocols import tempo as tempo_proto
+
+PROTOCOLS = ("basic", "tempo", "atlas", "epaxos", "janus", "fpaxos", "caesar")
+
+
+@dataclasses.dataclass(frozen=True)
+class Point:
+    """One grid point — the search keys of a `ResultsDB` entry."""
+
+    protocol: str
+    n: int
+    f: int
+    clients_per_region: int = 1
+    conflict_rate: int = 0
+    pool_size: int = 1
+    keys_per_command: int = 1
+    commands_per_client: int = 100
+    read_only_percentage: int = 0
+    payload_size: int = 100
+    seed: int = 0
+
+    def search(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["clients"] = d.pop("clients_per_region")
+        d["conflict"] = d.pop("conflict_rate")
+        return d
+
+    def workload(self) -> Workload:
+        return Workload(
+            shard_count=1,
+            key_gen=KeyGen.conflict_pool(self.conflict_rate, self.pool_size),
+            keys_per_command=self.keys_per_command,
+            commands_per_client=self.commands_per_client,
+            payload_size=self.payload_size,
+            read_only_percentage=self.read_only_percentage,
+        )
+
+
+def make_protocol_def(
+    name: str,
+    n: int,
+    keys_per_command: int,
+    *,
+    max_seq: Optional[int] = None,
+    key_space_hint: int = 0,
+    nfr: bool = False,
+    wait_condition: bool = True,
+) -> ProtocolDef:
+    """Dispatch to the per-protocol constructors (the analogue of the
+    per-protocol server binaries, `fantoch_ps/src/bin/*.rs`)."""
+    if name == "basic":
+        return basic_proto.make_protocol(n, keys_per_command)
+    if name == "tempo":
+        return tempo_proto.make_protocol(
+            n, keys_per_command, key_space_hint=key_space_hint, nfr=nfr
+        )
+    if name == "atlas":
+        return atlas_proto.make_protocol(n, keys_per_command, nfr=nfr)
+    if name == "janus":
+        return atlas_proto.make_janus(n, keys_per_command, nfr=nfr)
+    if name == "epaxos":
+        return epaxos_proto.make_protocol(n, keys_per_command, nfr=nfr)
+    if name == "fpaxos":
+        return fpaxos_proto.make_protocol(n, keys_per_command)
+    if name == "caesar":
+        assert max_seq is not None, "caesar sizes dep bitmaps by max_seq"
+        return caesar_proto.make_protocol(
+            n, keys_per_command, max_seq, wait_condition=wait_condition
+        )
+    raise ValueError(f"unknown protocol {name!r}; have {PROTOCOLS}")
+
+
+def _bucket_key(pt: Point) -> Tuple:
+    return (
+        pt.protocol,
+        pt.n,
+        pt.clients_per_region,
+        pt.keys_per_command,
+        pt.commands_per_client,
+        pt.pool_size,
+    )
+
+
+def run_grid(
+    points: Sequence[Point],
+    *,
+    planet: Optional[Planet] = None,
+    process_regions: Optional[Sequence[str]] = None,
+    client_regions: Optional[Sequence[str]] = None,
+    results_root: str = "results",
+    name: str = "sweep",
+    gc_interval_ms: int = 50,
+    extra_ms: int = 2000,
+    max_steps: int = 50_000_000,
+    mesh: Optional[jax.sharding.Mesh] = None,
+    chunk_steps: Optional[int] = None,
+    verbose: bool = False,
+) -> List[str]:
+    """Run every grid point and persist one results dir per shape bucket.
+
+    Returns the created directories (load them with `ResultsDB.load` on the
+    parent root)."""
+    planet = planet or Planet.new()
+    client_regions = list(client_regions or ["us-west1", "us-west2"])
+
+    buckets: Dict[Tuple, List[Point]] = {}
+    for pt in points:
+        buckets.setdefault(_bucket_key(pt), []).append(pt)
+
+    out_dirs: List[str] = []
+    for bi, (bkey, bpoints) in enumerate(sorted(buckets.items())):
+        pt0 = bpoints[0]
+        n = pt0.n
+        pregions = list(process_regions or [])
+        if not pregions:
+            pregions = [r for r in planet.regions()][:n]
+        assert len(pregions) >= n, "not enough regions for n processes"
+        pregions = pregions[:n]
+        C = len(client_regions) * pt0.clients_per_region
+        wl = pt0.workload()
+        max_seq = C * pt0.commands_per_client
+        pdef = make_protocol_def(
+            pt0.protocol,
+            n,
+            pt0.keys_per_command,
+            max_seq=max_seq,
+            key_space_hint=wl.key_space(C),
+        )
+        leader = 1 if not pdef.leaderless else None
+        placement = setup.Placement(pregions, client_regions, pt0.clients_per_region)
+
+        envs = []
+        searches = []
+        spec = None
+        for pt in bpoints:
+            config = Config(
+                n=n, f=pt.f, gc_interval_ms=gc_interval_ms, leader=leader
+            )
+            if spec is None:
+                spec = setup.build_spec(
+                    config,
+                    wl,
+                    pdef,
+                    n_clients=C,
+                    n_client_groups=len(client_regions),
+                    max_seq=max_seq,
+                    extra_ms=extra_ms,
+                    max_steps=max_steps,
+                )
+            envs.append(
+                setup.build_env(
+                    spec, config, planet, placement, pt.workload(), pdef,
+                    seed=pt.seed,
+                )
+            )
+            searches.append(pt.search())
+        batched = sweep.stack_envs(envs)
+        if mesh is not None:
+            # pad the batch to the mesh size so it shards evenly (repeating
+            # rows cyclically — pad may exceed the batch size)
+            B = len(envs)
+            D = mesh.devices.size
+            pad = (-B) % D
+            if pad:
+                reps = (B + pad + B - 1) // B
+                batched = jax.tree_util.tree_map(
+                    lambda x: np.concatenate([x] * reps)[: B + pad], batched
+                )
+            batched = sweep.shard_envs(batched, mesh)
+
+        if chunk_steps:
+            init, chunk, done = sweep.make_chunked_runner(spec, pdef, wl, chunk_steps)
+            st = init(batched)
+            while not done(st):
+                st = chunk(batched, st)
+                if verbose:
+                    print(
+                        f"bucket {bi}: steps "
+                        f"{np.asarray(st.step).sum()}", flush=True
+                    )
+        else:
+            st = sweep.run_batch(spec, pdef, wl, batched)
+        st = jax.tree_util.tree_map(np.asarray, st)
+        B = len(envs)
+        st = jax.tree_util.tree_map(lambda x: x[:B], st)  # drop mesh padding
+        summary.check_sim_health(st)
+
+        metrics = {}
+        if pdef.metrics is not None:
+            metrics = {
+                k: np.asarray(v) for k, v in pdef.metrics(st.proto).items()
+            }
+        out_dirs.append(
+            results_db.save_sweep(
+                results_root,
+                f"{name}_b{bi}",
+                searches,
+                hist=np.asarray(st.hist),
+                issued=np.asarray(st.c_issued),
+                client_group=np.stack([np.asarray(e.client_group) for e in envs]),
+                # completion time of the client workload (final_time includes
+                # the post-completion drain window)
+                sim_time_ms=np.asarray(st.final_time) - extra_ms,
+                steps=np.asarray(st.step),
+                client_regions=client_regions,
+                metrics=metrics,
+                extra_meta={"process_regions": list(pregions)},
+            )
+        )
+        if verbose:
+            print(f"bucket {bi} ({bkey}) -> {out_dirs[-1]}", flush=True)
+    return out_dirs

@@ -1,0 +1,1 @@
+from . import db, plots  # noqa: F401

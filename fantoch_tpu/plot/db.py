@@ -1,0 +1,174 @@
+"""Results database: persist and query sweep outputs.
+
+The TPU-native equivalent of `fantoch_plot`'s results layer (reference:
+`fantoch_plot/src/db/results_db.rs:19` `ResultsDB`,
+`fantoch_plot/src/db/exp_data.rs:14` `ExperimentData`): experiment runs live
+in timestamped directories; the DB loads them all and serves
+`find(search-keys) -> ExperimentData` lookups for the plot functions.
+
+On-disk layout (one directory per sweep invocation, like the reference's
+`create_exp_dir`, `fantoch_exp/src/bench.rs:904`):
+
+    <results_root>/<UTC timestamp>_<name>/
+        meta.json    — sweep-level metadata + one search-key record per config
+        data.npz     — batched result arrays (leading config axis)
+
+`data.npz` arrays: `hist` [B, G, NB] per-region latency buckets,
+`issued` [B, C], `client_group` [B, C], `sim_time_ms` [B], `steps` [B],
+plus one `metric_<name>` [B, n] array per protocol metric (fast/slow/commits/
+stable/...).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.metrics import Histogram
+
+
+@dataclasses.dataclass
+class ExperimentData:
+    """One configuration's results (reference `ExperimentData`)."""
+
+    search: Dict[str, Any]  # search keys: protocol, n, f, clients, conflict, …
+    client_latency: Dict[str, Histogram]  # region -> latency histogram
+    global_latency: Histogram  # all regions merged
+    issued_commands: int
+    sim_time_ms: int
+    steps: int
+    metrics: Dict[str, np.ndarray]  # per-process protocol metrics
+
+    @property
+    def throughput_cmds_per_sec(self) -> float:
+        if self.sim_time_ms <= 0:
+            return 0.0
+        return self.issued_commands / (self.sim_time_ms / 1000.0)
+
+    @property
+    def fast_path_rate(self) -> float:
+        fast = self.metrics.get("fast")
+        slow = self.metrics.get("slow")
+        if fast is None or slow is None:
+            return float("nan")
+        total = float(fast.sum() + slow.sum())
+        return float(fast.sum()) / total if total else float("nan")
+
+
+def save_sweep(
+    results_root: str,
+    name: str,
+    searches: Sequence[Dict[str, Any]],
+    *,
+    hist: np.ndarray,  # [B, G, NB]
+    issued: np.ndarray,  # [B, C]
+    client_group: np.ndarray,  # [B, C]
+    sim_time_ms: np.ndarray,  # [B]
+    steps: np.ndarray,  # [B]
+    client_regions: Sequence[str],
+    metrics: Optional[Dict[str, np.ndarray]] = None,  # name -> [B, n]
+    extra_meta: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Write one sweep's batched results; returns the created directory."""
+    B = len(searches)
+    assert hist.shape[0] == B and sim_time_ms.shape[0] == B
+    stamp = time.strftime("%Y_%m_%d_%H_%M_%S", time.gmtime())
+    out = os.path.join(results_root, f"{stamp}_{name}")
+    os.makedirs(out, exist_ok=True)
+    meta = {
+        "name": name,
+        "client_regions": list(client_regions),
+        "searches": list(searches),
+    }
+    if extra_meta:
+        meta.update(extra_meta)
+    with open(os.path.join(out, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+    arrays = {
+        "hist": np.asarray(hist),
+        "issued": np.asarray(issued),
+        "client_group": np.asarray(client_group),
+        "sim_time_ms": np.asarray(sim_time_ms),
+        "steps": np.asarray(steps),
+    }
+    for k, v in (metrics or {}).items():
+        arrays[f"metric_{k}"] = np.asarray(v)
+    np.savez_compressed(os.path.join(out, "data.npz"), **arrays)
+    return out
+
+
+class ResultsDB:
+    """Load every sweep directory under a root and serve searches."""
+
+    def __init__(self, entries: List[ExperimentData]):
+        self.entries = entries
+
+    @classmethod
+    def load(cls, results_root: str) -> "ResultsDB":
+        entries: List[ExperimentData] = []
+        if not os.path.isdir(results_root):
+            return cls(entries)
+        for d in sorted(os.listdir(results_root)):
+            path = os.path.join(results_root, d)
+            meta_path = os.path.join(path, "meta.json")
+            data_path = os.path.join(path, "data.npz")
+            if not (os.path.isfile(meta_path) and os.path.isfile(data_path)):
+                continue
+            entries.extend(cls._load_dir(meta_path, data_path))
+        return cls(entries)
+
+    @staticmethod
+    def _load_dir(meta_path: str, data_path: str) -> List[ExperimentData]:
+        with open(meta_path) as f:
+            meta = json.load(f)
+        data = np.load(data_path)
+        regions = meta["client_regions"]
+        out = []
+        metric_names = [
+            k[len("metric_"):] for k in data.files if k.startswith("metric_")
+        ]
+        for b, search in enumerate(meta["searches"]):
+            per_region: Dict[str, Histogram] = {}
+            merged = Histogram()
+            for g, region in enumerate(regions):
+                h = Histogram.from_buckets(data["hist"][b, g])
+                per_region[region] = h
+                merged.merge(h)
+            out.append(
+                ExperimentData(
+                    search=search,
+                    client_latency=per_region,
+                    global_latency=merged,
+                    issued_commands=int(data["issued"][b].sum()),
+                    sim_time_ms=int(data["sim_time_ms"][b]),
+                    steps=int(data["steps"][b]),
+                    metrics={
+                        name: data[f"metric_{name}"][b] for name in metric_names
+                    },
+                )
+            )
+        return out
+
+    def find(self, **search) -> List[ExperimentData]:
+        """All entries whose search keys match every given key exactly."""
+        hits = []
+        for e in self.entries:
+            if all(e.search.get(k) == v for k, v in search.items()):
+                hits.append(e)
+        return hits
+
+    def find_one(self, **search) -> ExperimentData:
+        hits = self.find(**search)
+        if len(hits) != 1:
+            raise KeyError(f"{len(hits)} entries match {search}")
+        return hits[0]
+
+    def __iter__(self) -> Iterator[ExperimentData]:
+        return iter(self.entries)
+
+    def __len__(self) -> int:
+        return len(self.entries)

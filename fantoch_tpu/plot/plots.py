@@ -1,0 +1,215 @@
+"""Plot families over `ResultsDB` data.
+
+The TPU-native equivalent of `fantoch_plot`'s matplotlib layer (reference:
+`fantoch_plot/src/lib.rs:185-2294`). The reference drives Python matplotlib
+through pyo3; here the analysis layer *is* Python, so the figures are direct
+matplotlib — same families:
+
+- `cdf_plot`            — latency CDFs, one line per search (`cdf_plot`)
+- `throughput_latency_plot` — latency vs throughput curves per protocol
+  (`throughput_something_plot`)
+- `fast_path_plot`      — fast-path rate vs an x key (`fast_path_plot`)
+- `latency_bar_plot`    — per-region mean latency bars (`nfr_plot` shape)
+- `heatmap_plot`        — metric over a 2-D config grid (`heatmap_plot`)
+- `metrics_table`       — text table of per-process metrics
+  (`process_metrics_table` / `dstat_table`)
+- `sim_output_stats`    — avg/p95/p99/p99.9 + fast-path summary per entry
+  (`bin/plot_sim_output.rs`)
+
+Figures are written to file (Agg backend); every function returns the path.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+from .db import ExperimentData  # noqa: E402
+
+PERCENTILES = (0.95, 0.99, 0.999)
+
+
+def _label(e: ExperimentData, keys: Optional[Sequence[str]] = None) -> str:
+    s = e.search
+    keys = keys or [k for k in ("protocol", "n", "f", "clients", "conflict") if k in s]
+    return " ".join(f"{k}={s[k]}" for k in keys)
+
+
+def sim_output_stats(entries: Sequence[ExperimentData]) -> List[Dict[str, Any]]:
+    """Per-entry latency/fast-path summary (plot_sim_output facts)."""
+    out = []
+    for e in entries:
+        h = e.global_latency
+        out.append(
+            {
+                **e.search,
+                "count": h.count(),
+                "avg_ms": h.mean(),
+                "p95_ms": h.percentile(0.95),
+                "p99_ms": h.percentile(0.99),
+                "p99_9_ms": h.percentile(0.999),
+                "throughput_cmds_s": e.throughput_cmds_per_sec,
+                "fast_path_rate": e.fast_path_rate,
+            }
+        )
+    return out
+
+
+def cdf_plot(
+    entries: Sequence[ExperimentData],
+    output: str,
+    label_keys: Optional[Sequence[str]] = None,
+) -> str:
+    fig, ax = plt.subplots(figsize=(6, 4))
+    for e in entries:
+        items = sorted(e.global_latency.values.items())
+        if not items:
+            continue
+        xs = np.array([v for v, _ in items], dtype=float)
+        cum = np.cumsum([c for _, c in items])
+        ys = cum / cum[-1]
+        ax.step(xs, ys, where="post", label=_label(e, label_keys))
+    ax.set_xlabel("latency (ms)")
+    ax.set_ylabel("CDF")
+    ax.set_ylim(0, 1)
+    ax.legend(fontsize=7)
+    ax.grid(alpha=0.3)
+    fig.savefig(output, bbox_inches="tight", dpi=150)
+    plt.close(fig)
+    return output
+
+
+def throughput_latency_plot(
+    series: Dict[str, Sequence[ExperimentData]],
+    output: str,
+    latency: str = "avg",  # avg | p95 | p99 | p99.9
+) -> str:
+    """One line per protocol: x = throughput, y = chosen latency stat —
+    the EuroSys'21-style headline figure (`README.md` plot.png)."""
+    stat: Callable[[ExperimentData], float]
+    if latency == "avg":
+        stat = lambda e: e.global_latency.mean()
+    else:
+        p = {"p95": 0.95, "p99": 0.99, "p99.9": 0.999}[latency]
+        stat = lambda e: e.global_latency.percentile(p)
+    fig, ax = plt.subplots(figsize=(6, 4))
+    for name, entries in series.items():
+        pts = sorted(
+            ((e.throughput_cmds_per_sec, stat(e)) for e in entries),
+            key=lambda t: t[0],
+        )
+        if not pts:
+            continue
+        xs, ys = zip(*pts)
+        ax.plot(xs, ys, marker="o", markersize=3, label=name)
+    ax.set_xlabel("throughput (cmds/s)")
+    ax.set_ylabel(f"{latency} latency (ms)")
+    ax.legend(fontsize=8)
+    ax.grid(alpha=0.3)
+    fig.savefig(output, bbox_inches="tight", dpi=150)
+    plt.close(fig)
+    return output
+
+
+def fast_path_plot(
+    series: Dict[str, Sequence[ExperimentData]],
+    x_key: str,
+    output: str,
+) -> str:
+    fig, ax = plt.subplots(figsize=(6, 4))
+    for name, entries in series.items():
+        pts = sorted((e.search[x_key], e.fast_path_rate) for e in entries)
+        if not pts:
+            continue
+        xs, ys = zip(*pts)
+        ax.plot(xs, [y * 100 for y in ys], marker="s", markersize=3, label=name)
+    ax.set_xlabel(x_key)
+    ax.set_ylabel("fast path (%)")
+    ax.set_ylim(0, 105)
+    ax.legend(fontsize=8)
+    ax.grid(alpha=0.3)
+    fig.savefig(output, bbox_inches="tight", dpi=150)
+    plt.close(fig)
+    return output
+
+
+def latency_bar_plot(
+    entries: Sequence[ExperimentData],
+    output: str,
+    label_keys: Optional[Sequence[str]] = None,
+    stat: str = "avg",
+) -> str:
+    """Grouped per-region latency bars, one group per region, one bar per
+    entry (the shape of `nfr_plot` / per-region latency figures)."""
+    regions: List[str] = []
+    for e in entries:
+        for r in e.client_latency:
+            if r not in regions:
+                regions.append(r)
+    width = 0.8 / max(len(entries), 1)
+    fig, ax = plt.subplots(figsize=(max(6, len(regions) * 1.2), 4))
+    xs = np.arange(len(regions))
+    for i, e in enumerate(entries):
+        ys = []
+        for r in regions:
+            h = e.client_latency.get(r)
+            if h is None or not h.count():
+                ys.append(0.0)
+            elif stat == "avg":
+                ys.append(h.mean())
+            else:
+                ys.append(h.percentile({"p95": 0.95, "p99": 0.99}[stat]))
+        ax.bar(xs + i * width, ys, width, label=_label(e, label_keys))
+    ax.set_xticks(xs + 0.4 - width / 2)
+    ax.set_xticklabels(regions, rotation=30, ha="right", fontsize=7)
+    ax.set_ylabel(f"{stat} latency (ms)")
+    ax.legend(fontsize=7)
+    fig.savefig(output, bbox_inches="tight", dpi=150)
+    plt.close(fig)
+    return output
+
+
+def heatmap_plot(
+    entries: Sequence[ExperimentData],
+    x_key: str,
+    y_key: str,
+    output: str,
+    value: Callable[[ExperimentData], float] = lambda e: e.global_latency.mean(),
+    value_label: str = "avg latency (ms)",
+) -> str:
+    xs = sorted({e.search[x_key] for e in entries})
+    ys = sorted({e.search[y_key] for e in entries})
+    grid = np.full((len(ys), len(xs)), np.nan)
+    for e in entries:
+        grid[ys.index(e.search[y_key]), xs.index(e.search[x_key])] = value(e)
+    fig, ax = plt.subplots(figsize=(6, 4))
+    im = ax.imshow(grid, origin="lower", aspect="auto", cmap="viridis")
+    ax.set_xticks(range(len(xs)))
+    ax.set_xticklabels(xs, fontsize=7)
+    ax.set_yticks(range(len(ys)))
+    ax.set_yticklabels(ys, fontsize=7)
+    ax.set_xlabel(x_key)
+    ax.set_ylabel(y_key)
+    fig.colorbar(im, label=value_label)
+    fig.savefig(output, bbox_inches="tight", dpi=150)
+    plt.close(fig)
+    return output
+
+
+def metrics_table(
+    entries: Sequence[ExperimentData],
+    label_keys: Optional[Sequence[str]] = None,
+) -> str:
+    """Text table of per-process protocol metrics (`process_metrics_table`)."""
+    lines = []
+    for e in entries:
+        lines.append(_label(e, label_keys))
+        for name, arr in sorted(e.metrics.items()):
+            vals = " ".join(f"{int(v):>8}" for v in np.asarray(arr).ravel())
+            lines.append(f"  {name:<10} {vals}")
+    return "\n".join(lines)

@@ -1,0 +1,65 @@
+"""End-to-end test of the experiment harness + results DB + plot layer.
+
+Covers the `fantoch_exp` -> results dir -> `fantoch_plot` pipeline shape
+(reference: `fantoch_exp/src/bench.rs:43` + `fantoch_plot/src/db/`): run a
+small two-protocol grid, reload it through `ResultsDB`, and render every
+plot family.
+"""
+import json
+import os
+
+from fantoch_tpu.exp.harness import Point, run_grid
+from fantoch_tpu.plot.db import ResultsDB
+from fantoch_tpu.plot import plots
+
+
+def test_grid_db_plots(tmp_path):
+    root = str(tmp_path / "results")
+    points = [
+        Point("basic", 3, 1, clients_per_region=1, conflict_rate=c,
+              commands_per_client=5, seed=s)
+        for c in (0, 100)
+        for s in (0,)
+    ] + [
+        Point("atlas", 3, 1, clients_per_region=1, conflict_rate=50,
+              commands_per_client=5),
+    ]
+    dirs = run_grid(points, results_root=root, name="t", extra_ms=1000)
+    assert len(dirs) == 2  # one bucket per protocol
+
+    db = ResultsDB.load(root)
+    assert len(db) == 3
+    basics = db.find(protocol="basic")
+    assert len(basics) == 2
+    e = db.find_one(protocol="atlas")
+    total = 2 * 5  # 2 client regions x 1 client x 5 commands
+    assert e.issued_commands == total
+    assert e.global_latency.count() == total
+    assert e.throughput_cmds_per_sec > 0
+    assert 0.0 <= e.fast_path_rate <= 1.0
+    assert (e.metrics["commits"] == total).all()
+
+    stats = plots.sim_output_stats(list(db))
+    assert len(stats) == 3
+    for s in stats:
+        assert s["count"] == total
+        assert s["avg_ms"] <= s["p99_ms"]
+    json.dumps(stats)  # serializable
+
+    out = str(tmp_path / "plots")
+    os.makedirs(out)
+    assert os.path.isfile(plots.cdf_plot(list(db), out + "/cdf.png"))
+    series = {"basic": basics, "atlas": [e]}
+    assert os.path.isfile(
+        plots.throughput_latency_plot(series, out + "/tl.png")
+    )
+    assert os.path.isfile(
+        plots.fast_path_plot(series, "conflict", out + "/fp.png")
+    )
+    assert os.path.isfile(
+        plots.latency_bar_plot(list(db), out + "/bars.png")
+    )
+    assert os.path.isfile(
+        plots.heatmap_plot(basics, "conflict", "seed", out + "/hm.png")
+    )
+    assert "commits" in plots.metrics_table([e])
