@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 
 from ..engine.types import ExecutorDef
+from ..ops.closure import transitive_closure
 from .ready import ReadyRing, ready_capacity, ready_drain, ready_init, ready_push, writer_id
 
 ORDER_HASH_MULT = jnp.int32(0x01000193)
@@ -92,13 +93,9 @@ def make_executor(n: int, max_deps: int) -> ExecutorDef:
             edge = V & has_dep[:, j] & V[tgt[:, j]]
             A = A.at[dots, tgt[:, j]].max(edge)
 
-        # transitive closure by boolean matrix squaring
-        def square(_, C):
-            Ci = C.astype(jnp.int32)
-            return C | ((Ci @ Ci) > 0)
-
-        steps = max(1, (DOTS - 1).bit_length())
-        R = jax.lax.fori_loop(0, steps, square, A)
+        # transitive closure by boolean matrix squaring (ops/closure.py:
+        # Pallas VMEM kernel on TPU, XLA composition elsewhere)
+        R = transitive_closure(A)
 
         blocked = bad | (R & bad[None, :]).any(axis=1)
         U = V & ~blocked

@@ -28,7 +28,8 @@ import jax
 import jax.numpy as jnp
 
 from ..engine.types import ExecutorDef
-from ..protocols.common.bitmap import bm_pack, bm_unpack, bm_words
+from ..ops.pred_ready import pred_ready
+from ..protocols.common.bitmap import bm_pack, bm_words
 from .ready import ReadyRing, ready_capacity, ready_drain, ready_init, ready_push, writer_id
 
 ORDER_HASH_MULT = jnp.int32(0x01000193)
@@ -70,13 +71,9 @@ def make_executor(n: int, max_seq: int) -> ExecutorDef:
         )
 
     def _ready_set(est: PredExecState, p):
-        """Commands whose both phases are satisfied right now."""
-        V = est.committed[p] & ~est.executed[p]  # [DOTS]
-        bits = bm_unpack(est.deps[p], DOTS)  # [DOTS(cmd), DOTS(dep)]
-        committed_ok = ~(bits & ~est.committed[p][None, :]).any(axis=1)
-        lower = est.clock[p][None, :] < est.clock[p][:, None]  # dep clock < cmd clock
-        executed_ok = ~(bits & lower & ~est.executed[p][None, :]).any(axis=1)
-        return V & committed_ok & executed_ok
+        """Commands whose both phases are satisfied right now (fused kernel,
+        ops/pred_ready.py: Pallas on TPU, XLA composition elsewhere)."""
+        return pred_ready(est.deps[p], est.committed[p], est.executed[p], est.clock[p])
 
     def _try_execute(ctx, est: PredExecState, p):
         KPC = ctx.spec.keys_per_command

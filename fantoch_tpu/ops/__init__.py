@@ -1,0 +1,16 @@
+"""Device kernels for the irregular hot ops (SURVEY.md §7 "Pallas kernels").
+
+Each op ships two interchangeable implementations:
+
+- an XLA composition (`*_xla`) — works on any backend, used on CPU and as
+  the correctness oracle;
+- a Pallas TPU kernel (`*_pallas`) — the VMEM-resident version for real
+  chips, also runnable anywhere via the Pallas interpreter.
+
+`dispatch.op_mode()` picks one per call site: `auto` (Pallas on TPU, XLA
+elsewhere), or forced via the `FANTOCH_TPU_OPS` env var
+(`xla` | `pallas` | `interpret`).
+"""
+from .closure import transitive_closure, transitive_closure_pallas, transitive_closure_xla  # noqa: F401
+from .dispatch import op_mode  # noqa: F401
+from .pred_ready import pred_ready, pred_ready_pallas, pred_ready_xla  # noqa: F401

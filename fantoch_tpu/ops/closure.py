@@ -1,0 +1,74 @@
+"""Transitive closure of a dependency adjacency matrix.
+
+The graph executor's readiness test (executors/graph.py, replacing the
+reference's recursive Tarjan SCC finder `fantoch_ps/src/executor/graph/
+tarjan.rs:96-200`) needs the reachability relation `R*` over the
+committed-but-unexecuted window. Closure-by-squaring is a chain of V×V
+matmuls — exactly MXU-shaped, so the Pallas version keeps the whole
+iteration in VMEM: load the (padded) adjacency once, square it
+ceil(log2(V)) times on the MXU, write the closure back once. The XLA
+composition is the same algorithm left to the compiler.
+
+Both variants take a bool [V, V] adjacency `A` (A[i, j] = i depends on j)
+and return the bool [V, V] reachability `R` (paths of length >= 1).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .dispatch import op_mode, pad_to_lane
+
+# single-block kernel: ~3 live [P, P] f32 buffers must fit ~16 MB VMEM
+_MAX_ROWS = 1024
+
+
+def _n_squarings(v: int) -> int:
+    """Squaring C <- C | C@C doubles covered path length; log2(V) rounds."""
+    return max(1, (max(v - 1, 1)).bit_length())
+
+
+def transitive_closure_xla(A: jnp.ndarray) -> jnp.ndarray:
+    V = A.shape[-1]
+
+    def square(_, C):
+        Ci = C.astype(jnp.float32)
+        return C | (jnp.dot(Ci, Ci, preferred_element_type=jnp.float32) > 0)
+
+    return jax.lax.fori_loop(0, _n_squarings(V), square, A)
+
+
+def _closure_kernel(steps: int, a_ref, out_ref):
+    c = a_ref[:]  # [P, P] float32 0/1
+
+    def body(_, c):
+        sq = jnp.dot(c, c, preferred_element_type=jnp.float32)
+        # saturate at 1 so values never overflow across iterations
+        return jnp.minimum(c + sq, 1.0)
+
+    out_ref[:] = jax.lax.fori_loop(0, steps, body, c)
+
+
+def transitive_closure_pallas(A: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
+    V = A.shape[-1]
+    P = pad_to_lane(V)
+    Af = jnp.zeros((P, P), jnp.float32).at[:V, :V].set(A.astype(jnp.float32))
+    out = pl.pallas_call(
+        functools.partial(_closure_kernel, _n_squarings(V)),
+        out_shape=jax.ShapeDtypeStruct((P, P), jnp.float32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(Af)
+    return out[:V, :V] > 0
+
+
+def transitive_closure(A: jnp.ndarray) -> jnp.ndarray:
+    mode = op_mode(pad_to_lane(A.shape[-1]), _MAX_ROWS)
+    if mode == "xla":
+        return transitive_closure_xla(A)
+    return transitive_closure_pallas(A, interpret=(mode == "interpret"))

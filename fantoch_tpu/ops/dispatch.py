@@ -1,0 +1,43 @@
+"""Backend dispatch for the ops package.
+
+`FANTOCH_TPU_OPS` overrides (read at trace time, i.e. at engine build):
+- ``auto`` (default): Pallas kernels on TPU backends, XLA compositions
+  elsewhere;
+- ``xla``: always the XLA composition;
+- ``pallas``: always the compiled Pallas kernel;
+- ``interpret``: the Pallas kernel under the interpreter (any backend —
+  used by tests to exercise kernel code paths on CPU).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_VALID = ("auto", "xla", "pallas", "interpret")
+
+LANE = 128  # TPU lane width
+
+
+def pad_to_lane(v: int) -> int:
+    """Pad a dimension up to a lane-width multiple (>= one full lane)."""
+    return max(LANE, -(-v // LANE) * LANE)
+
+
+def op_mode(vmem_rows: int = 0, max_rows: int = 1 << 30) -> str:
+    """Resolve the implementation to use: 'xla', 'pallas' or 'interpret'.
+
+    `vmem_rows`/`max_rows`: single-block Pallas kernels hold O(rows^2)
+    VMEM; when the caller's (padded) problem exceeds its VMEM-safe bound,
+    `auto` falls back to the XLA composition, which XLA tiles through HBM
+    freely. Forced `pallas`/`interpret` modes are honored regardless (tests
+    and explicit opt-ins).
+    """
+    mode = os.environ.get("FANTOCH_TPU_OPS", "auto").lower()
+    if mode not in _VALID:
+        raise ValueError(f"FANTOCH_TPU_OPS must be one of {_VALID}, got {mode!r}")
+    if mode == "auto":
+        if jax.default_backend() == "tpu" and vmem_rows <= max_rows:
+            return "pallas"
+        return "xla"
+    return mode

@@ -1,0 +1,95 @@
+"""Caesar predecessor-readiness predicate as a fused kernel.
+
+The predecessors executor (executors/pred.py, replacing the reference's
+two pending indexes + cascading retries, `fantoch_ps/src/executor/pred/
+mod.rs:154-275`) repeatedly evaluates, over the committed window:
+
+    ready(d) = committed(d) & ~executed(d)
+             & forall dep in deps(d): committed(dep)
+             & forall dep in deps(d), clock(dep) < clock(d): executed(dep)
+
+`deps` is a packed [DOTS, BW] int32 bitmap. The XLA composition unpacks it
+into a [DOTS, DOTS] bool matrix and reduces; the Pallas version fuses the
+unpack (broadcast shifts over each 32-bit word) with both masked row
+reductions in VMEM, so the DOTS x DOTS bit matrix never round-trips
+through HBM.
+
+All variants return a bool [DOTS] ready vector.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+import jax
+
+from ..protocols.common.bitmap import BITS, bm_unpack
+from .dispatch import op_mode, pad_to_lane
+
+# single-block kernel holds bits/lower/products [P, P] f32 in VMEM at once
+_MAX_ROWS = 512
+
+
+def pred_ready_xla(deps_packed, committed, executed, clock):
+    DOTS = committed.shape[0]
+    bits = bm_unpack(deps_packed, DOTS)  # [DOTS(cmd), DOTS(dep)]
+    committed_ok = ~(bits & ~committed[None, :]).any(axis=1)
+    lower = clock[None, :] < clock[:, None]
+    executed_ok = ~(bits & lower & ~executed[None, :]).any(axis=1)
+    return committed & ~executed & committed_ok & executed_ok
+
+
+def _ready_kernel(bw: int, deps_ref, crow_ref, erow_ref, krow_ref,
+                  ccol_ref, ecol_ref, kcol_ref, out_ref):
+    P = crow_ref.shape[1]
+    # unpack the dep bitmap: word w of row d holds dep bits BITS*w..BITS*w+15
+    shifts = lax.broadcasted_iota(jnp.int32, (1, BITS), 1)
+    chunks = []
+    for w in range(bw):
+        word = deps_ref[:, w][:, None]  # [P, 1]
+        chunks.append(((word >> shifts) & 1).astype(jnp.float32))  # [P, BITS]
+    bits = jnp.concatenate(chunks, axis=1)[:, :P]  # [P, P]
+
+    not_committed = 1.0 - crow_ref[:]  # [1, P]
+    not_executed = 1.0 - erow_ref[:]  # [1, P]
+    lower = (krow_ref[:] < kcol_ref[:]).astype(jnp.float32)  # [P, P]
+
+    blocked1 = (bits * not_committed).max(axis=1, keepdims=True)  # [P, 1]
+    blocked2 = (bits * lower * not_executed).max(axis=1, keepdims=True)
+    v = ccol_ref[:] * (1.0 - ecol_ref[:])  # [P, 1]
+    out_ref[:] = v * (1.0 - blocked1) * (1.0 - blocked2)
+
+
+def pred_ready_pallas(deps_packed, committed, executed, clock, interpret: bool = False):
+    DOTS = committed.shape[0]
+    BW = deps_packed.shape[1]
+    P = pad_to_lane(DOTS)
+    PW = max(BW, P // BITS)
+
+    deps = jnp.zeros((P, PW), jnp.int32).at[:DOTS, :BW].set(deps_packed)
+    c = jnp.zeros((P,), jnp.float32).at[:DOTS].set(committed.astype(jnp.float32))
+    e = jnp.zeros((P,), jnp.float32).at[:DOTS].set(executed.astype(jnp.float32))
+    # pad clocks with INF so padded deps bits (always 0) can't matter anyway
+    k = jnp.full((P,), 2**30, jnp.int32).at[:DOTS].set(clock)
+
+    vspec = pl.BlockSpec(memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        functools.partial(_ready_kernel, PW),
+        out_shape=jax.ShapeDtypeStruct((P, 1), jnp.float32),
+        in_specs=[vspec] * 7,
+        out_specs=vspec,
+        interpret=interpret,
+    )(deps, c[None, :], e[None, :], k[None, :], c[:, None], e[:, None], k[:, None])
+    return out[:DOTS, 0] > 0
+
+
+def pred_ready(deps_packed, committed, executed, clock):
+    mode = op_mode(pad_to_lane(committed.shape[0]), _MAX_ROWS)
+    if mode == "xla":
+        return pred_ready_xla(deps_packed, committed, executed, clock)
+    return pred_ready_pallas(
+        deps_packed, committed, executed, clock, interpret=(mode == "interpret")
+    )

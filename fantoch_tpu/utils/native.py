@@ -1,0 +1,113 @@
+"""ctypes loader for the native components (native/*.cpp).
+
+The shared library is built on demand with the toolchain's g++ (no
+pip/pybind dependency); the build is cached next to the sources. Used by
+tests to cross-validate the lock-step engine against the heap-driven native
+oracle (native/sim_oracle.cpp).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libfantoch_native.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build() -> None:
+    # make owns dependency tracking (a fresh build is a fast no-op)
+    try:
+        subprocess.run(
+            ["make", "-s"], cwd=_NATIVE_DIR, check=True, capture_output=True, text=True
+        )
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(f"native build failed:\n{e.stderr}") from e
+
+
+def load() -> ctypes.CDLL:
+    """Build (if stale) and load the native library."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        _build()
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.sim_basic.restype = ctypes.c_int
+        _lib = lib
+        return lib
+
+
+def _i32(a) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(a, dtype=np.int32))
+
+
+def sim_basic_oracle(
+    *,
+    n: int,
+    n_clients: int,
+    keys_per_command: int,
+    max_seq: int,
+    commands_per_client: int,
+    fq_size: int,
+    max_res: int,
+    extra_ms: int,
+    gc_interval_ms: int,
+    cleanup_ms: int,
+    max_steps: int,
+    dist_pp,
+    dist_pc,
+    dist_cp,
+    client_proc,
+    fq_mask,
+) -> dict:
+    """Run the native Basic-protocol oracle; returns per-client latency sums
+    and per-process commit/stable counters (see native/sim_oracle.cpp)."""
+    lib = load()
+    C = n_clients
+    dist_pp = _i32(dist_pp)
+    dist_pc = _i32(dist_pc)
+    dist_cp = _i32(dist_cp)
+    client_proc = _i32(client_proc)
+    fq_mask = _i32(fq_mask)
+    assert dist_pp.shape == (n, n) and dist_pc.shape == (n, C)
+    assert dist_cp.shape == (C,) and client_proc.shape == (C,) and fq_mask.shape == (n,)
+
+    lat_sum = np.zeros(C, np.int64)
+    lat_cnt = np.zeros(C, np.int32)
+    commit_count = np.zeros(n, np.int32)
+    stable_count = np.zeros(n, np.int32)
+    steps = ctypes.c_longlong(0)
+
+    def ptr(a, t):
+        return a.ctypes.data_as(ctypes.POINTER(t))
+
+    rc = lib.sim_basic(
+        n, C, keys_per_command, max_seq, commands_per_client,
+        fq_size, max_res, extra_ms, gc_interval_ms, cleanup_ms,
+        ctypes.c_longlong(max_steps),
+        ptr(dist_pp, ctypes.c_int32), ptr(dist_pc, ctypes.c_int32),
+        ptr(dist_cp, ctypes.c_int32), ptr(client_proc, ctypes.c_int32),
+        ptr(fq_mask, ctypes.c_int32),
+        ptr(lat_sum, ctypes.c_longlong), ptr(lat_cnt, ctypes.c_int32),
+        ptr(commit_count, ctypes.c_int32), ptr(stable_count, ctypes.c_int32),
+        ctypes.byref(steps),
+    )
+    if rc != 0:
+        raise RuntimeError(f"sim_basic oracle failed with code {rc}")
+    return {
+        "lat_sum": lat_sum,
+        "lat_cnt": lat_cnt,
+        "commit_count": commit_count,
+        "stable_count": stable_count,
+        "steps": int(steps.value),
+    }

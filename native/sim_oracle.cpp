@@ -1,0 +1,356 @@
+// Native discrete-event simulation oracle.
+//
+// An independent, heap-driven reimplementation of the framework's simulation
+// semantics (engine/lockstep.py), in the style of the reference's simulator
+// (reference: fantoch/src/sim/{schedule,runner,simulation}.rs — binary-heap
+// schedule keyed by time, message delay = one-way ping, deterministic
+// tie-break by insertion order). It runs the Basic protocol
+// (fantoch/src/protocol/basic.rs: f+1-ack replication) with its immediate
+// executor and closed-loop clients, and returns per-client latency sums plus
+// protocol counters.
+//
+// Purpose: cross-validation. The lock-step engine tensorizes the event loop
+// for TPU; this oracle executes the *same* event semantics with a classic
+// priority queue in native code. Tests assert both produce identical
+// latencies, step counts, and GC/commit counters — the framework's
+// "different discipline, same logic" check (the reference cross-validates
+// Sequential vs Atomic vs Locked state in the same way).
+//
+// Built as a shared library; driven via ctypes (fantoch_tpu/utils/native.py).
+
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <vector>
+
+namespace {
+
+constexpr int64_t INF_TIME = int64_t(1) << 30;
+
+// engine message kinds (engine/types.py)
+constexpr int KIND_SUBMIT = 0;
+constexpr int KIND_TO_CLIENT = 1;
+constexpr int KIND_PROTO_BASE = 2;
+
+// Basic protocol message kinds (protocols/basic.py)
+constexpr int MSTORE = 0;
+constexpr int MSTOREACK = 1;
+constexpr int MCOMMIT = 2;
+constexpr int MGC = 3;
+
+struct Event {
+  int64_t time;
+  int64_t seq;  // insertion order, the deterministic tie-break
+  int32_t src, dst, kind;
+  std::vector<int32_t> payload;
+};
+
+struct EventOrder {
+  bool operator()(const Event& a, const Event& b) const {
+    if (a.time != b.time) return a.time > b.time;  // min-heap
+    return a.seq > b.seq;
+  }
+};
+
+struct Sim {
+  // ---- config ----
+  int n, C, kpc, max_seq, commands_per_client;
+  int fq_size, max_res, extra_ms;
+  int64_t max_steps;
+  const int32_t* dist_pp;      // [n*n]
+  const int32_t* dist_pc;      // [n*C]
+  const int32_t* dist_cp;      // [C]
+  const int32_t* client_proc;  // [C]
+  const int32_t* fq_mask;      // [n]
+  std::vector<int64_t> per_interval;  // periodic slots (gc, cleanup)
+
+  // ---- engine state ----
+  std::priority_queue<Event, std::vector<Event>, EventOrder> pool;
+  int64_t now = 0, step = 0, seqno = 0;
+  std::vector<std::vector<int64_t>> per_next;  // [n][NPER]
+  bool all_done = false;
+  int64_t final_time = INF_TIME;
+  int clients_done = 0;
+
+  // command table
+  std::vector<int32_t> next_seq;                  // [n], 1-based
+  std::vector<int32_t> cmd_client, cmd_rifl;      // [DOTS]
+
+  // clients
+  std::vector<int64_t> c_start, lat_sum;          // [C]
+  std::vector<int32_t> c_issued, c_got, lat_cnt;  // [C]
+  std::vector<bool> c_done;                       // [C]
+
+  // Basic protocol state (protocols/basic.py)
+  std::vector<bool> has_cmd, buffered_commit;  // [n*DOTS]
+  std::vector<int32_t> acks;                   // [n*DOTS]
+  std::vector<int32_t> commit_count;           // [n]
+
+  // GC track (protocols/common/gc.py)
+  std::vector<bool> gc_committed;       // [n*DOTS]
+  std::vector<int32_t> gc_frontier;     // [n*n] own frontier per coordinator
+  std::vector<int32_t> gc_clock_of;     // [n*n*n]
+  std::vector<bool> gc_heard;           // [n*n]
+  std::vector<int32_t> gc_stable_wm;    // [n*n]
+  std::vector<int32_t> gc_stable;       // [n]
+
+  // executor ready rings (executors/ready.py; capacity irrelevant: deque)
+  std::vector<std::vector<std::pair<int32_t, int32_t>>> ready;  // [n]
+  std::vector<size_t> ready_pop;                                // [n]
+
+  int dots() const { return n * max_seq; }
+
+  void push_event(int64_t time, int src, int dst, int kind,
+                  std::vector<int32_t> payload) {
+    pool.push(Event{time, seqno++, src, dst, kind, std::move(payload)});
+  }
+
+  // protocol broadcast: engine candidate order is dst = 0..n-1
+  // (lockstep.py _insert_outbox), matching seqno assignment order
+  void send_proto(int src, int32_t tgt_mask, int proto_kind,
+                  const std::vector<int32_t>& payload) {
+    for (int dst = 0; dst < n; dst++) {
+      if ((tgt_mask >> dst) & 1) {
+        push_event(now + dist_pp[src * n + dst], src, dst,
+                   KIND_PROTO_BASE + proto_kind, payload);
+      }
+    }
+  }
+
+  // ---- GC (protocols/common/gc.py) ----
+  void gc_commit_dot(int p, int dot) {
+    gc_committed[p * dots() + dot] = true;
+    int a = dot / max_seq;  // coordinator (ids.py dot layout)
+    int32_t fr = gc_frontier[p * n + a];
+    while (fr < max_seq && gc_committed[p * dots() + a * max_seq + fr]) fr++;
+    gc_frontier[p * n + a] = fr;
+  }
+
+  void gc_handle_mgc(int p, int src, const int32_t* incoming) {
+    for (int a = 0; a < n; a++) {
+      int32_t& c = gc_clock_of[(p * n + src) * n + a];
+      if (incoming[a] > c) c = incoming[a];
+    }
+    gc_heard[p * n + src] = true;
+    bool all_heard = true;
+    for (int q = 0; q < n; q++)
+      if (q != p && !gc_heard[p * n + q]) all_heard = false;
+    if (!all_heard) return;
+    int64_t gained = 0;
+    for (int a = 0; a < n; a++) {
+      int32_t peer_min = INT32_MAX;
+      for (int q = 0; q < n; q++)
+        if (q != p) peer_min = std::min(peer_min, gc_clock_of[(p * n + q) * n + a]);
+      int32_t stable = std::min(gc_frontier[p * n + a], peer_min);
+      int32_t wm = std::max(gc_stable_wm[p * n + a], stable);
+      gained += wm - gc_stable_wm[p * n + a];
+      gc_stable_wm[p * n + a] = wm;
+    }
+    gc_stable[p] += int32_t(gained);
+  }
+
+  // ---- executor + result routing ----
+  void exec_commit(int p, int dot) {  // executor handle: immediate ready push
+    ready[p].emplace_back(cmd_client[dot], cmd_rifl[dot]);
+  }
+
+  // lockstep.py _route_results: drain up to max_res, emit completions
+  void drain_and_route(int p) {
+    int take = int(std::min<size_t>(ready[p].size() - ready_pop[p], max_res));
+    std::vector<std::pair<int32_t, int32_t>> batch;
+    for (int i = 0; i < take; i++) batch.push_back(ready[p][ready_pop[p] + i]);
+    ready_pop[p] += take;
+    if (ready_pop[p] == ready[p].size()) {
+      ready[p].clear();
+      ready_pop[p] = 0;
+    }
+    for (int i = 0; i < take; i++) {
+      int32_t c = batch[i].first, rifl = batch[i].second;
+      if (client_proc[c] != p) continue;  // not the submitting process
+      c_got[c]++;
+      bool complete = (c_got[c] == kpc);
+      bool is_last = true;  // only the last same-client row in batch emits
+      for (int j = i + 1; j < take; j++)
+        if (batch[j].first == c) is_last = false;
+      if (complete && is_last)
+        push_event(now + dist_pc[p * C + c], p, c, KIND_TO_CLIENT, {c, rifl});
+    }
+  }
+
+  // ---- Basic protocol handlers (protocols/basic.py) ----
+  void commit(int p, int dot) {
+    gc_commit_dot(p, dot);
+    commit_count[p]++;
+    for (int k = 0; k < kpc; k++) exec_commit(p, dot);
+  }
+
+  void handle_submit(const Event& ev) {
+    int p = ev.dst;
+    int32_t client = ev.payload[0], rifl = ev.payload[1];
+    int32_t seq = next_seq[p];
+    if (seq > max_seq) return;  // dot-window overflow (engine counts a drop)
+    next_seq[p]++;
+    int dot = p * max_seq + (seq - 1);
+    cmd_client[dot] = client;
+    cmd_rifl[dot] = rifl;
+    c_got[client] = 0;
+    send_proto(p, (1 << n) - 1, MSTORE, {dot, fq_mask[p]});
+    drain_and_route(p);  // engine drains after every handler (no-op here)
+  }
+
+  void handle_proto(const Event& ev) {
+    int p = ev.dst, src = ev.src;
+    int kind = ev.kind - KIND_PROTO_BASE;
+    const auto& pl = ev.payload;
+    switch (kind) {
+      case MSTORE: {
+        int dot = pl[0];
+        int32_t quorum_mask = pl[1];
+        has_cmd[p * dots() + dot] = true;
+        if ((quorum_mask >> p) & 1)
+          send_proto(p, 1 << src, MSTOREACK, {dot});
+        if (buffered_commit[p * dots() + dot]) {
+          buffered_commit[p * dots() + dot] = false;
+          commit(p, dot);
+        }
+        break;
+      }
+      case MSTOREACK: {
+        int dot = pl[0];
+        if (++acks[p * dots() + dot] == fq_size)
+          send_proto(p, (1 << n) - 1, MCOMMIT, {dot});
+        break;
+      }
+      case MCOMMIT: {
+        int dot = pl[0];
+        if (has_cmd[p * dots() + dot])
+          commit(p, dot);
+        else
+          buffered_commit[p * dots() + dot] = true;
+        break;
+      }
+      case MGC:
+        gc_handle_mgc(p, src, pl.data());
+        break;
+    }
+    drain_and_route(p);
+  }
+
+  void handle_to_client(const Event& ev) {
+    int32_t c = ev.payload[0];
+    int64_t lat = now - c_start[c];
+    lat_sum[c] += lat;
+    lat_cnt[c]++;
+    bool more = c_issued[c] < commands_per_client;
+    if (more) {
+      push_event(now + dist_cp[c], c, client_proc[c], KIND_SUBMIT,
+                 {c, c_issued[c] + 1, 0});
+      c_issued[c]++;
+      c_start[c] = now;
+    } else if (!c_done[c]) {
+      c_done[c] = true;
+      if (++clients_done >= C) {
+        all_done = true;
+        final_time = now + extra_ms;
+      }
+    }
+  }
+
+  void periodic_fire() {
+    // argmin over [n, NPER] row-major, first occurrence (lockstep.py)
+    int bp = 0, bk = 0;
+    int64_t bt = INF_TIME + 1;
+    const int nper = int(per_interval.size());
+    for (int p = 0; p < n; p++)
+      for (int k = 0; k < nper; k++)
+        if (per_next[p][k] < bt) bt = per_next[p][k], bp = p, bk = k;
+    per_next[bp][bk] += per_interval[bk];
+    if (bk == 0) {
+      // GarbageCollection broadcast (basic.py periodic)
+      std::vector<int32_t> row(gc_frontier.begin() + bp * n,
+                               gc_frontier.begin() + (bp + 1) * n);
+      send_proto(bp, ((1 << n) - 1) & ~(1 << bp), MGC, row);
+    } else {
+      drain_and_route(bp);  // executor cleanup tick
+    }
+  }
+
+  void run() {
+    // initial submits: client c arrives at its coordinator at dist_cp[c]
+    for (int c = 0; c < C; c++)
+      push_event(dist_cp[c], c, client_proc[c], KIND_SUBMIT, {c, 1, 0});
+
+    // loop-condition placement matches the engine's `lax.while_loop`: the
+    // guard reads the *previous* iteration's `now`, so the first event past
+    // `final_time` is still processed and counted
+    while (!(all_done && now > final_time) && step < max_steps &&
+           now < INF_TIME) {
+      int64_t t_pool = pool.empty() ? INF_TIME : pool.top().time;
+      int64_t t_per = INF_TIME;
+      for (auto& row : per_next)
+        for (int64_t t : row) t_per = std::min(t_per, t);
+      now = std::min(t_pool, t_per);
+      step++;
+      if (t_pool <= t_per) {
+        Event ev = pool.top();
+        pool.pop();
+        switch (ev.kind) {
+          case KIND_SUBMIT: handle_submit(ev); break;
+          case KIND_TO_CLIENT: handle_to_client(ev); break;
+          default: handle_proto(ev); break;
+        }
+      } else {
+        periodic_fire();
+      }
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success. Outputs: lat_sum/lat_cnt per client, commit/stable
+// counters per process, total engine steps.
+int sim_basic(int n, int C, int kpc, int max_seq, int commands_per_client,
+              int fq_size, int max_res, int extra_ms, int gc_interval_ms,
+              int cleanup_ms, long long max_steps, const int32_t* dist_pp,
+              const int32_t* dist_pc, const int32_t* dist_cp,
+              const int32_t* client_proc, const int32_t* fq_mask,
+              long long* lat_sum, int32_t* lat_cnt, int32_t* commit_count,
+              int32_t* stable_count, long long* out_steps) {
+  if (n < 1 || n > 31 || C < 1 || kpc < 1) return 1;
+  Sim s;
+  s.n = n; s.C = C; s.kpc = kpc; s.max_seq = max_seq;
+  s.commands_per_client = commands_per_client;
+  s.fq_size = fq_size; s.max_res = max_res; s.extra_ms = extra_ms;
+  s.max_steps = max_steps;
+  s.dist_pp = dist_pp; s.dist_pc = dist_pc; s.dist_cp = dist_cp;
+  s.client_proc = client_proc; s.fq_mask = fq_mask;
+  s.per_interval = {gc_interval_ms, cleanup_ms};
+  s.per_next.assign(n, {int64_t(gc_interval_ms), int64_t(cleanup_ms)});
+  int D = s.dots();
+  s.next_seq.assign(n, 1);
+  s.cmd_client.assign(D, 0); s.cmd_rifl.assign(D, 0);
+  s.c_start.assign(C, 0); s.lat_sum.assign(C, 0);
+  s.c_issued.assign(C, 1); s.c_got.assign(C, 0); s.lat_cnt.assign(C, 0);
+  s.c_done.assign(C, false);
+  s.has_cmd.assign(n * D, false); s.buffered_commit.assign(n * D, false);
+  s.acks.assign(n * D, 0); s.commit_count.assign(n, 0);
+  s.gc_committed.assign(n * D, false); s.gc_frontier.assign(n * n, 0);
+  s.gc_clock_of.assign(n * n * n, 0); s.gc_heard.assign(n * n, false);
+  s.gc_stable_wm.assign(n * n, 0); s.gc_stable.assign(n, 0);
+  s.ready.assign(n, {}); s.ready_pop.assign(n, 0);
+
+  s.run();
+
+  for (int c = 0; c < C; c++) { lat_sum[c] = s.lat_sum[c]; lat_cnt[c] = s.lat_cnt[c]; }
+  for (int p = 0; p < n; p++) {
+    commit_count[p] = s.commit_count[p];
+    stable_count[p] = s.gc_stable[p];
+  }
+  *out_steps = s.step;
+  return 0;
+}
+
+}  // extern "C"

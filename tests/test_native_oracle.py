@@ -1,0 +1,97 @@
+"""Cross-validation: lock-step TPU engine vs the native C++ oracle.
+
+The native oracle (native/sim_oracle.cpp) re-implements the simulation
+semantics with a classic binary-heap schedule — the reference's architecture
+(`fantoch/src/sim/schedule.rs`) — in a completely independent codebase. Both
+engines must agree *exactly* on per-client latency sums/counts, commit and
+GC-stable counters for the Basic protocol (the same cross-discipline check
+the reference applies across its Sequential/Atomic/Locked state variants).
+"""
+import shutil
+
+import jax
+import numpy as np
+import pytest
+
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.planet import Planet
+from fantoch_tpu.core.workload import KeyGen, Workload
+from fantoch_tpu.engine import lockstep, setup, summary
+from fantoch_tpu.protocols import basic as basic_proto
+from fantoch_tpu.utils.native import sim_basic_oracle
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+
+
+def run_both(n, f, process_regions, client_regions, clients_per_region, cmds):
+    planet = Planet.new()
+    config = Config(n=n, f=f, gc_interval_ms=100)
+    workload = Workload(
+        shard_count=1,
+        key_gen=KeyGen.conflict_pool(conflict_rate=100, pool_size=1),
+        keys_per_command=1,
+        commands_per_client=cmds,
+    )
+    pdef = basic_proto.make_protocol(n, 1)
+    C = len(client_regions) * clients_per_region
+    spec = setup.build_spec(
+        config, workload, pdef, n_clients=C, n_client_groups=len(client_regions),
+        extra_ms=1000, max_steps=5_000_000,
+    )
+    placement = setup.Placement(process_regions, client_regions, clients_per_region)
+    env = setup.build_env(spec, config, planet, placement, workload, pdef)
+
+    st = jax.jit(lockstep.make_run(spec, pdef, workload))(env)
+    st = jax.tree_util.tree_map(np.asarray, st)
+    summary.check_sim_health(st)
+    engine = {
+        "lat_sum": st.lat_sum.astype(np.int64),
+        "lat_cnt": st.lat_cnt,
+        "commit_count": np.asarray(st.proto.commit_count),
+        "stable_count": np.asarray(st.proto.gc.stable_count),
+        "steps": int(st.step),
+    }
+
+    oracle = sim_basic_oracle(
+        n=n,
+        n_clients=C,
+        keys_per_command=1,
+        max_seq=spec.max_seq,
+        commands_per_client=cmds,
+        fq_size=int(env.fq_size),
+        max_res=spec.max_res,
+        extra_ms=spec.extra_ms,
+        gc_interval_ms=100,
+        cleanup_ms=spec.cleanup_ms,
+        max_steps=spec.max_steps,
+        dist_pp=env.dist_pp,
+        dist_pc=env.dist_pc,
+        dist_cp=env.dist_cp,
+        client_proc=env.client_proc,
+        fq_mask=env.fq_mask,
+    )
+    return engine, oracle
+
+
+CASES = [
+    (3, 1, ["asia-east1", "us-central1", "us-west1"], ["us-west1", "us-west2"], 1, 20),
+    (3, 0, ["asia-east1", "us-central1", "us-west1"], ["us-west1", "us-west2"], 2, 15),
+    (
+        5,
+        2,
+        ["asia-east1", "us-central1", "us-west1", "europe-west2", "europe-west3"],
+        ["us-west1", "europe-west2"],
+        2,
+        10,
+    ),
+]
+
+
+@pytest.mark.parametrize("n,f,pregions,cregions,cpr,cmds", CASES)
+def test_engine_matches_native_oracle(n, f, pregions, cregions, cpr, cmds):
+    engine, oracle = run_both(n, f, pregions, cregions, cpr, cmds)
+    np.testing.assert_array_equal(engine["lat_cnt"], oracle["lat_cnt"])
+    np.testing.assert_array_equal(engine["lat_sum"], oracle["lat_sum"])
+    np.testing.assert_array_equal(engine["commit_count"], oracle["commit_count"])
+    np.testing.assert_array_equal(engine["stable_count"], oracle["stable_count"])
+    assert engine["steps"] == oracle["steps"]
