@@ -37,6 +37,7 @@ from .types import (
     INF_TIME,
     KIND_PROTO_BASE,
     KIND_SUBMIT,
+    KIND_TICK,
     KIND_TO_CLIENT,
     CmdView,
     Ctx,
@@ -71,6 +72,9 @@ class SimSpec:
     reorder: bool  # random ×[0,10) message delay multiplier (sim_test mode)
     max_steps: int
     max_res: int  # executor results drained per call
+    # open-loop clients: issue on an interval tick instead of on reply
+    # (run/task/client/mod.rs:190 open_loop_client); None = closed loop
+    open_loop_interval_ms: Optional[int] = None
 
     @property
     def dots(self) -> int:
@@ -127,11 +131,15 @@ class SimState(NamedTuple):
     cmd_rifl: jnp.ndarray  # [DOTS] int32
     cmd_keys: jnp.ndarray  # [DOTS, KPC] int32
     cmd_ro: jnp.ndarray  # [DOTS] bool
-    # clients (closed loop, one outstanding command each)
+    # clients (closed loop: one outstanding command; open loop: interval
+    # ticks with per-command submit times)
     c_start: jnp.ndarray  # [C] int32 submit wall-time of outstanding command
     c_issued: jnp.ndarray  # [C] int32 commands issued so far
+    c_resp: jnp.ndarray  # [C] int32 commands completed (open loop)
+    c_sub_time: jnp.ndarray  # [C, CMDS] int32 per-command issue time (open loop)
     c_done: jnp.ndarray  # [C] bool
-    c_got: jnp.ndarray  # [C] int32 partial results received for outstanding cmd
+    c_got: jnp.ndarray  # [C, CT] int32 partial results per outstanding cmd
+    # (closed loop: CT=1, one outstanding; open loop: CT=commands_per_client)
     clients_done: jnp.ndarray
     final_time: jnp.ndarray
     all_done: jnp.ndarray
@@ -269,15 +277,17 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
     # executor plumbing
     # ------------------------------------------------------------------
 
-    def _ctx(st: SimState, env: Env) -> Ctx:
+    def _ctx(st: SimState, env: Env, p) -> Ctx:
         return Ctx(
             spec=spec,
             env=env,
             cmds=CmdView(st.cmd_client, st.cmd_rifl, st.cmd_keys, st.cmd_ro),
+            pid=jnp.asarray(p, jnp.int32),
         )
 
     def _route_results(st: SimState, env: Env, p, res: ResOut) -> SimState:
         MR = spec.max_res
+        CT = st.c_got.shape[1]
         # every replica executes, but only the submitting process has the
         # command registered in its Pending (`runner.rs:351-362` wait_for) —
         # results elsewhere are dropped (`add_executor_result` -> None)
@@ -285,11 +295,16 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         valid = res.valid & (env.client_proc[cclip] == p)
         res = res._replace(valid=valid)
         cidx = jnp.where(valid, res.client, C)
-        got = st.c_got.at[cidx].add(1, mode="drop")
+        # partial results are tracked per outstanding command (AggregatePending,
+        # fantoch/src/executor/aggregate.rs) — slot by rifl in open loop
+        rslot = jnp.clip(res.rifl_seq - 1, 0, CT - 1)
+        got = st.c_got.at[cidx, rslot].add(1, mode="drop")
         st = st._replace(c_got=got)
-        complete = res.valid & (got[cclip] == KPC)
-        # only the last partial result of a client in this batch completes it
-        same = res.client[None, :] == res.client[:, None]  # [MR, MR]
+        complete = res.valid & (got[cclip, rslot] == KPC)
+        # only the last partial result of a command in this batch completes it
+        same = (res.client[None, :] == res.client[:, None]) & (
+            res.rifl_seq[None, :] == res.rifl_seq[:, None]
+        )  # [MR, MR]
         later = jnp.triu(same, k=1) & res.valid[None, :]
         is_last = ~later.any(axis=1)
         emit = complete & is_last
@@ -306,7 +321,7 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         return _insert(st, cand)
 
     def _apply_execout(st: SimState, env: Env, p, execout: ExecOut) -> SimState:
-        ctx = _ctx(st, env)
+        ctx = _ctx(st, env, p)
         estate = st.exec
         for i in range(pdef.max_exec):
             new_est = exdef.handle(ctx, estate, p, execout.info[i], st.now)
@@ -336,9 +351,11 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             cmd_rifl=st.cmd_rifl.at[flat].set(jnp.where(ok, rifl_seq, st.cmd_rifl[flat])),
             cmd_keys=st.cmd_keys.at[flat].set(jnp.where(ok, keys, st.cmd_keys[flat])),
             cmd_ro=st.cmd_ro.at[flat].set(jnp.where(ok, ro, st.cmd_ro[flat])),
-            c_got=st.c_got.at[client].set(0, mode="drop"),
+            c_got=st.c_got.at[
+                client, jnp.clip(rifl_seq - 1, 0, st.c_got.shape[1] - 1)
+            ].set(0, mode="drop"),
         )
-        ctx = _ctx(st, env)
+        ctx = _ctx(st, env, p)
         pst, outbox, execout = pdef.submit(ctx, st.proto, p, flat, st.now)
         st = st._replace(proto=_tree_select(ok, pst, st.proto))
         outbox = outbox._replace(valid=outbox.valid & ok)
@@ -346,58 +363,112 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         st = _insert_outbox(st, env, p, outbox)
         return _apply_execout(st, env, p, execout)
 
-    def _client_branch(env, op):
-        st, src, dst, kind, payload = op
-        c = payload[0]
-        lat = st.now - st.c_start[c]
-        g = env.client_group[c]
-        st = st._replace(
-            hist=st.hist.at[g, jnp.clip(lat, 0, NB - 1)].add(1),
-            hist_overflow=st.hist_overflow + (lat >= NB).astype(jnp.int32),
-            lat_sum=st.lat_sum.at[c].add(lat),
-            lat_cnt=st.lat_cnt.at[c].add(1),
-        )
-        more = st.c_issued[c] < spec.commands_per_client
-        keys, ro = workload_mod.sample_command_keys(
-            consts,
-            jax.random.wrap_key_data(env.seed),
-            c,
-            st.c_issued[c],
-            env.conflict_rate,
-            env.read_only_pct,
-        )
-        payload_row = _pad_payload(
-            [c[None], (st.c_issued[c] + 1)[None], ro.astype(jnp.int32)[None]]
-            + [keys[i][None] for i in range(KPC)],
-            1,
-        )
-        cand = Candidates(
-            valid=more[None],
-            time=(st.now + _delay(st, env, env.dist_cp[c][None])),
-            src=c[None],
-            dst=env.client_proc[c][None],
-            kind=jnp.full((1,), KIND_SUBMIT, jnp.int32),
-            payload=payload_row,
-        )
-        newly_done = ~more & ~st.c_done[c]
+    def _mark_done(st: SimState, c, newly_done):
         clients_done = st.clients_done + newly_done.astype(jnp.int32)
         all_done = clients_done >= C
-        st = st._replace(
-            c_issued=st.c_issued.at[c].add(more.astype(jnp.int32)),
-            c_start=st.c_start.at[c].set(jnp.where(more, st.now, st.c_start[c])),
-            c_done=st.c_done.at[c].set(st.c_done[c] | ~more),
+        return st._replace(
+            c_done=st.c_done.at[c].set(st.c_done[c] | newly_done),
             clients_done=clients_done,
             final_time=jnp.where(
                 all_done & ~st.all_done, st.now + spec.extra_ms, st.final_time
             ),
             all_done=all_done,
         )
+
+    def _record_latency(env, st: SimState, c, lat):
+        g = env.client_group[c]
+        return st._replace(
+            hist=st.hist.at[g, jnp.clip(lat, 0, NB - 1)].add(1),
+            hist_overflow=st.hist_overflow + (lat >= NB).astype(jnp.int32),
+            lat_sum=st.lat_sum.at[c].add(lat),
+            lat_cnt=st.lat_cnt.at[c].add(1),
+        )
+
+    def _sample(env, st, c, idx):
+        return workload_mod.sample_command_keys(
+            consts,
+            jax.random.wrap_key_data(env.seed),
+            c,
+            idx,
+            env.conflict_rate,
+            env.read_only_pct,
+        )
+
+    def _submit_candidate(env, st, c, rifl, ro, keys):
+        payload_row = _pad_payload(
+            [c[None], rifl[None], ro.astype(jnp.int32)[None]]
+            + [keys[i][None] for i in range(KPC)],
+            1,
+        )
+        return Candidates(
+            valid=jnp.ones((1,), jnp.bool_),
+            time=(st.now + _delay(st, env, env.dist_cp[c][None])),
+            src=c[None],
+            dst=env.client_proc[c][None],
+            kind=jnp.full((1,), KIND_SUBMIT, jnp.int32),
+            payload=payload_row,
+        )
+
+    def _client_branch(env, op):
+        st, src, dst, kind, payload = op
+        c = payload[0]
+        if spec.open_loop_interval_ms is not None:
+            # open loop: record this command's latency; issuance is driven by
+            # the tick stream, completion by the response count
+            rifl = payload[1]
+            lat = st.now - st.c_sub_time[c, jnp.clip(rifl - 1, 0, st.c_sub_time.shape[1] - 1)]
+            st = _record_latency(env, st, c, lat)
+            resp = st.c_resp[c] + 1
+            st = st._replace(c_resp=st.c_resp.at[c].set(resp))
+            newly_done = (resp >= spec.commands_per_client) & ~st.c_done[c]
+            return _mark_done(st, c, newly_done)
+        lat = st.now - st.c_start[c]
+        st = _record_latency(env, st, c, lat)
+        more = st.c_issued[c] < spec.commands_per_client
+        keys, ro = _sample(env, st, c, st.c_issued[c])
+        cand = _submit_candidate(env, st, c, st.c_issued[c] + 1, ro, keys)
+        cand = cand._replace(valid=more[None])
+        newly_done = ~more & ~st.c_done[c]
+        st = st._replace(
+            c_issued=st.c_issued.at[c].add(more.astype(jnp.int32)),
+            c_start=st.c_start.at[c].set(jnp.where(more, st.now, st.c_start[c])),
+        )
+        st = _mark_done(st, c, newly_done)
         return _insert(st, cand)
+
+    def _tick_branch(env, op):
+        """Open-loop interval tick: issue the next command now and schedule
+        the following tick (run/task/client/mod.rs:190)."""
+        st, src, dst, kind, payload = op
+        c = payload[0]
+        i = st.c_issued[c]
+        more = i < spec.commands_per_client
+        keys, ro = _sample(env, st, c, i)
+        sub = _submit_candidate(env, st, c, i + 1, ro, keys)
+        sub = sub._replace(valid=more[None])
+        slot = jnp.clip(i, 0, st.c_sub_time.shape[1] - 1)
+        st = st._replace(
+            c_sub_time=st.c_sub_time.at[c, slot].set(
+                jnp.where(more, st.now, st.c_sub_time[c, slot])
+            ),
+            c_issued=st.c_issued.at[c].add(more.astype(jnp.int32)),
+        )
+        st = _insert(st, sub)
+        interval = spec.open_loop_interval_ms or 1
+        tick = Candidates(
+            valid=(more & ((i + 1) < spec.commands_per_client))[None],
+            time=(st.now + interval)[None],
+            src=c[None],
+            dst=c[None],
+            kind=jnp.full((1,), KIND_TICK, jnp.int32),
+            payload=_pad_payload([c[None]], 1),
+        )
+        return _insert(st, tick)
 
     def _proto_branch(env, op):
         st, src, dst, kind, payload = op
         p = dst
-        ctx = _ctx(st, env)
+        ctx = _ctx(st, env, p)
         pst, outbox, execout = pdef.handle(
             ctx, st.proto, p, src, kind - KIND_PROTO_BASE, payload, st.now
         )
@@ -419,10 +490,11 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         st = st._replace(m_valid=st.m_valid.at[slot].set(False))
         op = (st, src, dst, kind, payload)
         return jax.lax.switch(
-            jnp.clip(kind, 0, 2),
+            jnp.clip(kind, 0, 3),
             [
                 functools.partial(_submit_branch, env),
                 functools.partial(_client_branch, env),
+                functools.partial(_tick_branch, env),
                 functools.partial(_proto_branch, env),
             ],
             op,
@@ -438,7 +510,7 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         for slot_i, proto_kind in enumerate(spec.proto_periodic_kinds):
             def proto_ev(env, op, proto_kind=proto_kind):
                 st, p = op
-                ctx = _ctx(st, env)
+                ctx = _ctx(st, env, p)
                 pst, outbox = pdef.periodic(ctx, st.proto, p, proto_kind, st.now)
                 st = st._replace(proto=pst)
                 return _insert_outbox(st, env, p, outbox)
@@ -446,7 +518,7 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         if exec_notify_slot is not None:
             def exec_notify(env, op):
                 st, p = op
-                ctx = _ctx(st, env)
+                ctx = _ctx(st, env, p)
                 estate, info = exdef.executed(ctx, st.exec, p)
                 st = st._replace(exec=estate)
                 pst, outbox = pdef.handle_executed(ctx, st.proto, p, info, st.now)
@@ -455,7 +527,7 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             branches.append(functools.partial(exec_notify, env))
         def cleanup(env, op):
             st, p = op
-            ctx = _ctx(st, env)
+            ctx = _ctx(st, env, p)
             estate, res = exdef.drain(ctx, st.exec, p)
             st = st._replace(exec=estate)
             return _route_results(st, env, p, res)
@@ -469,6 +541,7 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
     # ------------------------------------------------------------------
 
     def init_state(env: Env) -> SimState:
+        OPEN = spec.open_loop_interval_ms is not None
         clients = jnp.arange(C, dtype=jnp.int32)
         keys0, ro0 = jax.vmap(
             lambda c: workload_mod.sample_command_keys(
@@ -480,23 +553,29 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
                 env.read_only_pct,
             )
         )(clients)
-        # initial submits occupy pool slots 0..C-1
+        # closed loop: initial submits occupy pool slots 0..C-1;
+        # open loop: the slots hold the first interval ticks instead
         payload0 = jnp.zeros((S, W), jnp.int32)
         payload0 = payload0.at[:C, 0].set(clients)
-        payload0 = payload0.at[:C, 1].set(1)
-        payload0 = payload0.at[:C, 2].set(ro0.astype(jnp.int32))
-        payload0 = payload0.at[:C, 3 : 3 + KPC].set(keys0)
+        if not OPEN:
+            payload0 = payload0.at[:C, 1].set(1)
+            payload0 = payload0.at[:C, 2].set(ro0.astype(jnp.int32))
+            payload0 = payload0.at[:C, 3 : 3 + KPC].set(keys0)
         st = SimState(
             now=jnp.int32(0),
             step=jnp.int32(0),
             seqno=jnp.int32(C),
             dropped=jnp.int32(0),
             m_valid=jnp.arange(S) < C,
-            m_time=jnp.zeros((S,), jnp.int32).at[:C].set(env.dist_cp),
+            m_time=jnp.zeros((S,), jnp.int32).at[:C].set(
+                jnp.zeros((C,), jnp.int32) if OPEN else env.dist_cp
+            ),
             m_seq=jnp.arange(S, dtype=jnp.int32),
             m_src=jnp.zeros((S,), jnp.int32).at[:C].set(clients),
-            m_dst=jnp.zeros((S,), jnp.int32).at[:C].set(env.client_proc),
-            m_kind=jnp.full((S,), KIND_SUBMIT, jnp.int32),
+            m_dst=jnp.zeros((S,), jnp.int32).at[:C].set(
+                clients if OPEN else env.client_proc
+            ),
+            m_kind=jnp.full((S,), KIND_TICK if OPEN else KIND_SUBMIT, jnp.int32),
             m_payload=payload0,
             next_seq=jnp.ones((n,), jnp.int32),
             cmd_client=jnp.zeros((DOTS,), jnp.int32),
@@ -504,9 +583,15 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             cmd_keys=jnp.zeros((DOTS, KPC), jnp.int32),
             cmd_ro=jnp.zeros((DOTS,), jnp.bool_),
             c_start=jnp.zeros((C,), jnp.int32),
-            c_issued=jnp.ones((C,), jnp.int32),
+            c_issued=jnp.zeros((C,), jnp.int32) if OPEN else jnp.ones((C,), jnp.int32),
+            c_resp=jnp.zeros((C,), jnp.int32),
+            c_sub_time=jnp.zeros(
+                (C, spec.commands_per_client if OPEN else 1), jnp.int32
+            ),
             c_done=jnp.zeros((C,), jnp.bool_),
-            c_got=jnp.zeros((C,), jnp.int32),
+            c_got=jnp.zeros(
+                (C, spec.commands_per_client if OPEN else 1), jnp.int32
+            ),
             clients_done=jnp.int32(0),
             final_time=INF_TIME,
             all_done=jnp.bool_(False),
@@ -518,8 +603,9 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             proto=pdef.init(spec, env),
             exec=exdef.init(spec, env),
         )
-        if spec.reorder:
+        if spec.reorder and not OPEN:
             # apply the reorder multiplier to the initial submits too
+            # (open-loop initial ticks are client-local, no network delay)
             key = jax.random.fold_in(jax.random.wrap_key_data(env.seed), 0x7FFFFFFF)
             u = jax.random.uniform(key, (C,), minval=0.0, maxval=10.0)
             t0 = jnp.floor(env.dist_cp.astype(jnp.float32) * u).astype(jnp.int32)

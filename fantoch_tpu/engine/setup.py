@@ -38,6 +38,7 @@ def build_spec(
     reorder: bool = False,
     max_steps: int = 1 << 30,
     max_res: int = 4,
+    open_loop_interval_ms: Optional[int] = None,
 ) -> SimSpec:
     assert config.gc_interval_ms is not None, (
         "the simulator requires gc to be running (reference runner.rs:75)"
@@ -94,6 +95,7 @@ def build_spec(
         reorder=reorder,
         max_steps=max_steps,
         max_res=max_res,
+        open_loop_interval_ms=open_loop_interval_ms,
     )
 
 

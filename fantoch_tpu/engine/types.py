@@ -37,7 +37,8 @@ import jax.numpy as jnp
 # engine-owned message kinds; protocol kinds start at KIND_PROTO_BASE
 KIND_SUBMIT = 0
 KIND_TO_CLIENT = 1
-KIND_PROTO_BASE = 2
+KIND_TICK = 2  # open-loop client interval tick (run/task/client/mod.rs:190)
+KIND_PROTO_BASE = 3
 
 # "never" timestamp for disabled timers / empty pools
 INF_TIME = jnp.int32(2**30)
@@ -121,11 +122,20 @@ class CmdView(NamedTuple):
 
 
 class Ctx(NamedTuple):
-    """Read-only context handed to every handler."""
+    """Read-only context handed to every handler.
+
+    `pid` is the handling process's *global* identity (0-based). Handlers
+    must use `pid` for identity logic (quorum membership, self-masks,
+    ballots, vote ownership) and the `p` argument only to index the state
+    row. Under the single-chip engine the two coincide; under the
+    distributed runner (parallel/quantum.py) each device holds one state
+    row (`p == 0`) while `pid` is its mesh position.
+    """
 
     spec: Any  # SimSpec (static)
     env: Any  # Env (per-config arrays)
     cmds: CmdView
+    pid: Any = None  # traced int32 global process id of the handling process
 
 
 @dataclasses.dataclass(frozen=True)

@@ -157,8 +157,8 @@ def _make(variant: str, n: int, keys_per_command: int, nfr: bool) -> ProtocolDef
         dot, qmask = payload[0], payload[1]
         rdeps = payload[2 : 2 + D]
         is_start = st.status[p, dot] == START
-        in_q = bit(qmask, p) == 1
-        from_self = src == p
+        in_q = bit(qmask, ctx.pid) == 1
+        from_self = src == ctx.pid
         q_en = is_start & in_q
 
         # quorum member extends the coordinator's deps with its own latests;
@@ -217,7 +217,9 @@ def _make(variant: str, n: int, keys_per_command: int, nfr: bool) -> ProtocolDef
         slow = all_in & ~thr_ok
 
         st = st._replace(
-            synod=synod_mod.skip_prepare(st.synod, p, dot, jnp.int32(0), slow),
+            synod=synod_mod.skip_prepare(
+                st.synod, p, dot, jnp.int32(0), slow, pid=ctx.pid
+            ),
             prop_deps=st.prop_deps.at[p, dot].set(
                 jnp.where(slow, union, st.prop_deps[p, dot])
             ),
@@ -228,7 +230,7 @@ def _make(variant: str, n: int, keys_per_command: int, nfr: bool) -> ProtocolDef
         row_tgt = jnp.where(fast, ctx.env.all_mask, ctx.env.wq_mask[p])
         commit_payload = jnp.concatenate([dot[None], union]).astype(jnp.int32)
         cons_payload = jnp.concatenate(
-            [dot[None], (p + 1)[None], union]
+            [dot[None], (ctx.pid + 1)[None], union]
         ).astype(jnp.int32)
         width = cons_payload.shape[0]
         commit_payload = jnp.concatenate(
@@ -299,7 +301,9 @@ def _make(variant: str, n: int, keys_per_command: int, nfr: bool) -> ProtocolDef
         return st, ob, empty_execout(MAX_EXEC, EW)
 
     def h_mgc(ctx, st: AtlasState, p, src, payload, now):
-        st = st._replace(gc=gc_mod.gc_handle_mgc(st.gc, p, src, payload[:n]))
+        st = st._replace(
+            gc=gc_mod.gc_handle_mgc(st.gc, p, src, payload[:n], pid=ctx.pid)
+        )
         return st, empty_outbox(MAX_OUT, MSG_W), empty_execout(MAX_EXEC, EW)
 
     def handle(ctx, st, p, src, kind, payload, now):
@@ -317,7 +321,7 @@ def _make(variant: str, n: int, keys_per_command: int, nfr: bool) -> ProtocolDef
         return jax.lax.switch(kind, branches, st, p, src, payload, now)
 
     def periodic(ctx, st: AtlasState, p, kind, now):
-        all_but_me = ctx.env.all_mask & ~(jnp.int32(1) << p)
+        all_but_me = ctx.env.all_mask & ~(jnp.int32(1) << ctx.pid)
         row = gc_mod.gc_frontier_row(st.gc, p)
         ob = outbox_row(
             empty_outbox(1, MSG_W), 0,

@@ -110,7 +110,7 @@ def make_protocol(n: int, keys_per_command: int = 1) -> ProtocolDef:
     def h_mstore(ctx, st: BasicState, p, src, payload, now):
         dot, quorum_mask = payload[0], payload[1]
         st = st._replace(has_cmd=st.has_cmd.at[p, dot].set(True))
-        in_quorum = bit(quorum_mask, p) == 1
+        in_quorum = bit(quorum_mask, ctx.pid) == 1
         ob = _outbox1(in_quorum, jnp.int32(1) << src, MSTOREACK, [dot])
         # flush a buffered commit now that the payload arrived
         buffered = st.buffered_commit[p, dot]
@@ -138,7 +138,9 @@ def make_protocol(n: int, keys_per_command: int = 1) -> ProtocolDef:
         return st, empty_outbox(MAX_OUT, MSG_W), execout
 
     def h_mgc(ctx, st: BasicState, p, src, payload, now):
-        st = st._replace(gc=gc_mod.gc_handle_mgc(st.gc, p, src, payload[:n]))
+        st = st._replace(
+            gc=gc_mod.gc_handle_mgc(st.gc, p, src, payload[:n], pid=ctx.pid)
+        )
         return st, empty_outbox(MAX_OUT, MSG_W), empty_execout(MAX_EXEC, EW)
 
     def handle(ctx, st, p, src, kind, payload, now):
@@ -150,7 +152,7 @@ def make_protocol(n: int, keys_per_command: int = 1) -> ProtocolDef:
 
     def periodic(ctx, st: BasicState, p, kind, now):
         # GarbageCollection: broadcast own committed clock (basic.rs:320-331)
-        all_but_me = ctx.env.all_mask & ~(jnp.int32(1) << p)
+        all_but_me = ctx.env.all_mask & ~(jnp.int32(1) << ctx.pid)
         row = gc_mod.gc_frontier_row(st.gc, p)
         ob = _outbox1(jnp.bool_(True), all_but_me, MGC, [row[a] for a in range(n)])
         return st, ob

@@ -185,10 +185,12 @@ def make_protocol(
     # clock + predecessor helpers (common/pred/clocks)
     # ------------------------------------------------------------------
 
-    def _clock_next(st: CaesarState, p, enable):
-        """KeyClocks::clock_next — (seq+1, p), strictly above all seen."""
+    def _clock_next(st: CaesarState, p, pid, enable):
+        """KeyClocks::clock_next — (seq+1, pid), strictly above all seen.
+
+        `pid` is the global identity embedded in the composite clock."""
         seq = st.clk_cur[p] // CLOCK_PIDS + 1
-        new = seq * CLOCK_PIDS + p
+        new = seq * CLOCK_PIDS + pid
         st = st._replace(
             clk_cur=st.clk_cur.at[p].set(
                 jnp.where(jnp.asarray(enable), new, st.clk_cur[p])
@@ -214,17 +216,17 @@ def make_protocol(
     # ------------------------------------------------------------------
 
     def submit(ctx, st: CaesarState, p, dot, now):
-        st, clock = _clock_next(st, p, True)
+        st, clock = _clock_next(st, p, ctx.pid, True)
         ob = outbox_row(
             empty_outbox(MAX_OUT, MSG_W), 0,
             jnp.bool_(True), ctx.env.all_mask, MPROPOSE, [dot, clock],
         )
         return st, ob, empty_execout(MAX_EXEC, EW)
 
-    def _flush_rows(st: CaesarState, ob, p, dot, enable):
+    def _flush_rows(st: CaesarState, ob, p, pid, dot, enable):
         """Re-emit buffered MRetry/MCommit as 0-delay self-messages once the
         MPropose payload has arrived (caesar.rs:497-510)."""
-        me = jnp.int32(1) << p
+        me = jnp.int32(1) << pid
         ob = outbox_row(
             ob, 1, enable & st.bufr_valid[p, dot], me, MRETRY,
             [dot, st.bufr_clock[p, dot], st.bufr_from[p, dot]]
@@ -286,7 +288,7 @@ def make_protocol(
 
         # REJECT: fresh clock + full predecessor set in the nack
         # (reject_command, caesar.rs:1120-1146 — the registered clock stays)
-        st, new_clock = _clock_next(st, p, reject)
+        st, new_clock = _clock_next(st, p, ctx.pid, reject)
         nack_deps = bm_pack(conflict & st.in_clocks[p], BW)
 
         st = st._replace(
@@ -306,7 +308,7 @@ def make_protocol(
             accept | reject, jnp.int32(1) << src, MPROPOSEACK,
             [dot, ack_clock, accept.astype(jnp.int32)] + list(ack_deps),
         )
-        st, ob = _flush_rows(st, ob, p, dot, active)
+        st, ob = _flush_rows(st, ob, p, ctx.pid, dot, active)
         return st, ob, empty_execout(MAX_EXEC, EW)
 
     def h_mproposeack(ctx, st: CaesarState, p, src, payload, now):
@@ -341,15 +343,15 @@ def make_protocol(
             empty_outbox(MAX_OUT, MSG_W), 0,
             all_in, ctx.env.all_mask,
             jnp.where(fast, MCOMMIT, MRETRY),
-            [dot, st.qc_clock[p, dot], p] + list(st.qc_deps[p, dot]),
+            [dot, st.qc_clock[p, dot], ctx.pid] + list(st.qc_deps[p, dot]),
         )
         return st, ob, empty_execout(MAX_EXEC, EW)
 
-    def _unblock_row(st: CaesarState, ob, row, p, enable):
+    def _unblock_row(st: CaesarState, ob, row, p, pid, enable):
         """Schedule a 0-delay self `MUNBLOCK` scan (try_to_unblock)."""
         pending = st.waiting[p].any()
         return outbox_row(
-            ob, row, enable & pending, jnp.int32(1) << p, MUNBLOCK, [],
+            ob, row, enable & pending, jnp.int32(1) << pid, MUNBLOCK, [],
         )
 
     def h_mcommit(ctx, st: CaesarState, p, src, payload, now):
@@ -392,7 +394,7 @@ def make_protocol(
             valid=jnp.broadcast_to(can, (MAX_EXEC,)),
             info=jnp.concatenate([dot[None], clock[None], rdeps])[None, :],
         )
-        ob = _unblock_row(st, empty_outbox(MAX_OUT, MSG_W), 0, p, can)
+        ob = _unblock_row(st, empty_outbox(MAX_OUT, MSG_W), 0, p, ctx.pid, can)
         return st, ob, execout
 
     def h_mretry(ctx, st: CaesarState, p, src, payload, now):
@@ -433,7 +435,7 @@ def make_protocol(
             can, jnp.int32(1) << mfrom, MRETRYACK,
             [dot, p, jnp.int32(0)] + list(rdeps | mine),
         )
-        ob = _unblock_row(st, ob, 1, p, can)
+        ob = _unblock_row(st, ob, 1, p, ctx.pid, can)
         return st, ob, empty_execout(MAX_EXEC, EW)
 
     def h_mretryack(ctx, st: CaesarState, p, src, payload, now):
@@ -454,7 +456,7 @@ def make_protocol(
         ob = outbox_row(
             empty_outbox(MAX_OUT, MSG_W), 0,
             all_in, ctx.env.all_mask, MCOMMIT,
-            [dot, st.clock_of[p, dot], p] + list(st.qr_deps[p, dot]),
+            [dot, st.clock_of[p, dot], ctx.pid] + list(st.qr_deps[p, dot]),
         )
         return st, ob, empty_execout(MAX_EXEC, EW)
 
@@ -490,7 +492,7 @@ def make_protocol(
         do_acc = has & acc[wc]
         do_rej = has & rej[wc]
 
-        st, new_clock = _clock_next(st, p, do_rej)
+        st, new_clock = _clock_next(st, p, ctx.pid, do_rej)
         conflict = _conflicts(ctx, p, wc) & st.in_clocks[p]
         nack_deps = bm_pack(conflict, BW)
         st = st._replace(
@@ -509,7 +511,7 @@ def make_protocol(
         )
         # more decisions pending -> rescan at the same simulated time
         ob = outbox_row(
-            ob, 1, ndec > 1, jnp.int32(1) << p, MUNBLOCK, [],
+            ob, 1, ndec > 1, jnp.int32(1) << ctx.pid, MUNBLOCK, [],
         )
         return st, ob, empty_execout(MAX_EXEC, EW)
 
@@ -532,7 +534,7 @@ def make_protocol(
             ),
         )
         # newly-stable blockers may unblock waiting proposals
-        ob = _unblock_row(st, empty_outbox(MAX_OUT, MSG_W), 0, p, gained > 0)
+        ob = _unblock_row(st, empty_outbox(MAX_OUT, MSG_W), 0, p, ctx.pid, gained > 0)
         return st, ob, empty_execout(MAX_EXEC, EW)
 
     def handle(ctx, st, p, src, kind, payload, now):
@@ -554,15 +556,15 @@ def make_protocol(
         """Fold the executor's executed set into our own GC row
         (`Protocol::handle_executed`, caesar.rs:194-213)."""
         st = st._replace(
-            gcexec=st.gcexec.at[p, p].set(st.gcexec[p, p] | info[:BW])
+            gcexec=st.gcexec.at[p, ctx.pid].set(st.gcexec[p, ctx.pid] | info[:BW])
         )
         return st, empty_outbox(MAX_OUT, MSG_W)
 
     def periodic(ctx, st: CaesarState, p, kind, now):
-        all_but_me = ctx.env.all_mask & ~(jnp.int32(1) << p)
+        all_but_me = ctx.env.all_mask & ~(jnp.int32(1) << ctx.pid)
         ob = outbox_row(
             empty_outbox(1, MSG_W), 0,
-            jnp.bool_(True), all_but_me, MGC, list(st.gcexec[p, p]),
+            jnp.bool_(True), all_but_me, MGC, list(st.gcexec[p, ctx.pid]),
         )
         return st, ob
 

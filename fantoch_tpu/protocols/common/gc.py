@@ -63,15 +63,18 @@ def gc_commit(gc: GCTrack, p, dot, enable, max_seq: int) -> GCTrack:
     )
 
 
-def gc_handle_mgc(gc: GCTrack, p, src, incoming: jnp.ndarray) -> GCTrack:
+def gc_handle_mgc(gc: GCTrack, p, src, incoming: jnp.ndarray, pid=None) -> GCTrack:
     """Join a peer's committed clock and fold newly-stable dots into the
-    Stable metric (inlines the `MStable` self-forward)."""
-    n = gc.frontier.shape[0]
+    Stable metric (inlines the `MStable` self-forward).
+
+    `pid` is the process's global identity (ctx.pid); `p` only indexes the
+    state row (they differ under the distributed runner)."""
+    n = gc.clock_of.shape[1]
     gc = gc._replace(
         clock_of=gc.clock_of.at[p, src].set(jnp.maximum(gc.clock_of[p, src], incoming)),
         heard_from=gc.heard_from.at[p, src].set(True),
     )
-    others = jnp.arange(n) != p
+    others = jnp.arange(n) != (p if pid is None else pid)
     all_heard = jnp.where(others, gc.heard_from[p], True).all()
     peer_min = jnp.where(others[:, None], gc.clock_of[p], jnp.int32(2**30)).min(axis=0)
     stable = jnp.minimum(gc.frontier[p], peer_min)

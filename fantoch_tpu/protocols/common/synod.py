@@ -53,11 +53,14 @@ def set_if_not_accepted(sy: SynodState, p, dot, value, enable=True) -> SynodStat
     )
 
 
-def skip_prepare(sy: SynodState, p, dot, value, enable=True) -> SynodState:
+def skip_prepare(sy: SynodState, p, dot, value, enable=True, pid=None) -> SynodState:
     """Start a phase-2-only round with ballot = 1-based own id; returns state
-    ready to count accepts for `value`."""
+    ready to count accepts for `value`.
+
+    `pid` is the process's global identity (ctx.pid); `p` only indexes the
+    state row (they differ under the distributed runner)."""
     enable = jnp.asarray(enable)
-    ballot = p + 1
+    ballot = (p if pid is None else pid) + 1
 
     def setw(a, v):
         return a.at[p, dot].set(jnp.where(enable, v, a[p, dot]))

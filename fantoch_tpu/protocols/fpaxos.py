@@ -134,7 +134,7 @@ def make_protocol(n: int, keys_per_command: int = 1) -> ProtocolDef:
         return st, (enable, ctx.env.wq_mask[p], MACCEPT, [b0, slot, dot])
 
     def submit(ctx, st: FPaxosState, p, dot, now):
-        is_leader = p == ctx.env.leader
+        is_leader = ctx.pid == ctx.env.leader
         st, accept = _leader_assign(ctx, st, p, dot, is_leader)
         ob = empty_outbox(MAX_OUT, MSG_W)
         # non-leader: forward to the leader (fpaxos.rs:182-193)
@@ -144,7 +144,7 @@ def make_protocol(n: int, keys_per_command: int = 1) -> ProtocolDef:
 
     def h_mforward(ctx, st: FPaxosState, p, src, payload, now):
         dot = payload[0]
-        st, accept = _leader_assign(ctx, st, p, dot, p == ctx.env.leader)
+        st, accept = _leader_assign(ctx, st, p, dot, ctx.pid == ctx.env.leader)
         ob = outbox_row(empty_outbox(MAX_OUT, MSG_W), 0, *accept)
         return st, ob, empty_execout(MAX_EXEC, EW)
 
@@ -207,7 +207,7 @@ def make_protocol(n: int, keys_per_command: int = 1) -> ProtocolDef:
             peer_committed=st.peer_committed.at[p, src].set(payload[0]),
             heard=st.heard.at[p, src].set(True),
         )
-        others = jnp.arange(n) != p
+        others = jnp.arange(n) != ctx.pid
         all_heard = jnp.where(others, st.heard[p], True).all()
         peer_min = jnp.where(others, st.peer_committed[p], jnp.int32(2**30)).min()
         stable = jnp.where(all_heard, jnp.minimum(st.frontier[p], peer_min), 0)
@@ -233,7 +233,7 @@ def make_protocol(n: int, keys_per_command: int = 1) -> ProtocolDef:
 
     def periodic(ctx, st: FPaxosState, p, kind, now):
         # GarbageCollection: broadcast own committed frontier (fpaxos.rs:363-378)
-        all_but_me = ctx.env.all_mask & ~(jnp.int32(1) << p)
+        all_but_me = ctx.env.all_mask & ~(jnp.int32(1) << ctx.pid)
         ob = outbox_row(
             empty_outbox(MAX_OUT, MSG_W), 0, jnp.bool_(True), all_but_me, MGC,
             [st.frontier[p]],

@@ -250,8 +250,8 @@ def make_protocol(
         st, clock, ss, es = _proposal(ctx, st, p, dot, jnp.int32(0), jnp.bool_(True))
         # store coordinator votes for later aggregation (tempo.rs:297-310)
         st = st._replace(
-            votes_s=st.votes_s.at[p, dot, :, p].set(ss),
-            votes_e=st.votes_e.at[p, dot, :, p].set(es),
+            votes_s=st.votes_s.at[p, dot, :, ctx.pid].set(ss),
+            votes_e=st.votes_e.at[p, dot, :, ctx.pid].set(es),
         )
         # NFR single-key reads use a plain majority as the fast quorum
         # (BaseProcess::maybe_adjust_fast_quorum)
@@ -270,8 +270,8 @@ def make_protocol(
     def h_mcollect(ctx, st: TempoState, p, src, payload, now):
         dot, rclock, qmask = payload[0], payload[1], payload[2]
         is_start = st.status[p, dot] == START
-        in_q = bit(qmask, p) == 1
-        from_self = src == p
+        in_q = bit(qmask, ctx.pid) == 1
+        from_self = src == ctx.pid
 
         # fast-quorum member: own proposal with the remote clock as minimum;
         # from self: keep the already-computed clock and votes (tempo.rs:389-427)
@@ -348,7 +348,7 @@ def make_protocol(
         ob = empty_outbox(MAX_OUT, MSG_W)
         # optimization: bump own keys to the quorum max (tempo.rs:505-521)
         st, ob = _detached_rows(
-            ctx, st, ob, 1, p, dot, new_max, collect & (src != p)
+            ctx, st, ob, 1, p, dot, new_max, collect & (src != ctx.pid)
         )
 
         # all fast-quorum clocks in? (tempo.rs:524-570)
@@ -362,13 +362,15 @@ def make_protocol(
         commit_payload = _mcommit_payload(votes_s, votes_e, p, dot, new_max)
         # slow path: synod with skipped prepare (ballot = 1-based own id)
         st = st._replace(
-            synod=synod_mod.skip_prepare(st.synod, p, dot, new_max, slow),
+            synod=synod_mod.skip_prepare(
+                st.synod, p, dot, new_max, slow, pid=ctx.pid
+            ),
             fast_count=st.fast_count.at[p].add(fast.astype(jnp.int32)),
             slow_count=st.slow_count.at[p].add(slow.astype(jnp.int32)),
         )
         row_kind = jnp.where(fast, MCOMMIT, MCONSENSUS)
         row_tgt = jnp.where(fast, ctx.env.all_mask, ctx.env.wq_mask[p])
-        cons_payload = [dot, p + 1, new_max]
+        cons_payload = [dot, ctx.pid + 1, new_max]
         width = max(len(commit_payload), len(cons_payload))
         pay = jnp.where(
             fast,
@@ -468,7 +470,9 @@ def make_protocol(
         return st, ob, empty_execout(MAX_EXEC, EW)
 
     def h_mgc(ctx, st: TempoState, p, src, payload, now):
-        st = st._replace(gc=gc_mod.gc_handle_mgc(st.gc, p, src, payload[:n]))
+        st = st._replace(
+            gc=gc_mod.gc_handle_mgc(st.gc, p, src, payload[:n], pid=ctx.pid)
+        )
         return st, empty_outbox(MAX_OUT, MSG_W), empty_execout(MAX_EXEC, EW)
 
     def handle(ctx, st, p, src, kind, payload, now):
@@ -493,7 +497,7 @@ def make_protocol(
     def periodic(ctx, st: TempoState, p, kind, now):
         if kind == 0:
             # GarbageCollection (tempo.rs:973-988)
-            all_but_me = ctx.env.all_mask & ~(jnp.int32(1) << p)
+            all_but_me = ctx.env.all_mask & ~(jnp.int32(1) << ctx.pid)
             row = gc_mod.gc_frontier_row(st.gc, p)
             ob = outbox_row(
                 empty_outbox(1, MSG_W), 0,

@@ -27,10 +27,11 @@ namespace {
 
 constexpr int64_t INF_TIME = int64_t(1) << 30;
 
-// engine message kinds (engine/types.py)
+// engine message kinds (engine/types.py; KIND_TICK = 2 is the open-loop
+// client tick, which the closed-loop oracle never emits)
 constexpr int KIND_SUBMIT = 0;
 constexpr int KIND_TO_CLIENT = 1;
-constexpr int KIND_PROTO_BASE = 2;
+constexpr int KIND_PROTO_BASE = 3;
 
 // Basic protocol message kinds (protocols/basic.py)
 constexpr int MSTORE = 0;
