@@ -75,6 +75,15 @@ class SimSpec:
     # open-loop clients: issue on an interval tick instead of on reply
     # (run/task/client/mod.rs:190 open_loop_client); None = closed loop
     open_loop_interval_ms: Optional[int] = None
+    # client-side batching (run/task/client/batcher.rs:15-60): merge up to
+    # `batch_max_size` open-loop commands into one protocol command
+    # (Command::merge, command.rs:204-214), flushing a partial batch once it
+    # is `batch_max_delay_ms` old or the client has issued its last command.
+    # keys_per_command above is the merged command's key-slot count
+    # (workload keys x batch_max_size); unused slots repeat the last real
+    # key, which leaves the conflict set identical to the reference's merge.
+    batch_max_size: int = 1
+    batch_max_delay_ms: int = 0
 
     @property
     def dots(self) -> int:
@@ -140,6 +149,13 @@ class SimState(NamedTuple):
     c_done: jnp.ndarray  # [C] bool
     c_got: jnp.ndarray  # [C, CT] int32 partial results per outstanding cmd
     # (closed loop: CT=1, one outstanding; open loop: CT=commands_per_client)
+    # client-side batcher (open loop + batch_max_size > 1)
+    b_cnt: jnp.ndarray  # [C] int32 logical commands in the current batch
+    b_first_rifl: jnp.ndarray  # [C] int32
+    b_first_time: jnp.ndarray  # [C] int32
+    b_keys: jnp.ndarray  # [C, KPC] int32 accumulated merged key slots
+    b_ro: jnp.ndarray  # [C] bool all-read-only so far
+    c_batch_count: jnp.ndarray  # [C, CT] int32 batch size by first rifl
     clients_done: jnp.ndarray
     final_time: jnp.ndarray
     all_done: jnp.ndarray
@@ -375,13 +391,15 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             all_done=all_done,
         )
 
-    def _record_latency(env, st: SimState, c, lat):
+    def _record_latency(env, st: SimState, c, lat, enable=None):
         g = env.client_group[c]
+        en = jnp.bool_(True) if enable is None else enable
+        inc = en.astype(jnp.int32)
         return st._replace(
-            hist=st.hist.at[g, jnp.clip(lat, 0, NB - 1)].add(1),
-            hist_overflow=st.hist_overflow + (lat >= NB).astype(jnp.int32),
-            lat_sum=st.lat_sum.at[c].add(lat),
-            lat_cnt=st.lat_cnt.at[c].add(1),
+            hist=st.hist.at[g, jnp.clip(lat, 0, NB - 1)].add(inc),
+            hist_overflow=st.hist_overflow + (en & (lat >= NB)).astype(jnp.int32),
+            lat_sum=st.lat_sum.at[c].add(lat * inc),
+            lat_cnt=st.lat_cnt.at[c].add(inc),
         )
 
     def _sample(env, st, c, idx):
@@ -395,6 +413,8 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         )
 
     def _submit_candidate(env, st, c, rifl, ro, keys):
+        # `keys` is a list/array of KPC merged key slots (a single logical
+        # command pads its slots by repeating the last key)
         payload_row = _pad_payload(
             [c[None], rifl[None], ro.astype(jnp.int32)[None]]
             + [keys[i][None] for i in range(KPC)],
@@ -413,12 +433,20 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         st, src, dst, kind, payload = op
         c = payload[0]
         if spec.open_loop_interval_ms is not None:
-            # open loop: record this command's latency; issuance is driven by
-            # the tick stream, completion by the response count
-            rifl = payload[1]
-            lat = st.now - st.c_sub_time[c, jnp.clip(rifl - 1, 0, st.c_sub_time.shape[1] - 1)]
-            st = _record_latency(env, st, c, lat)
-            resp = st.c_resp[c] + 1
+            # open loop: record latencies for every logical command in the
+            # completed batch (unbatcher, run/task/client/unbatcher.rs);
+            # issuance is driven by the tick stream, completion by the
+            # response count
+            first_rifl = payload[1]
+            CT = st.c_sub_time.shape[1]
+            B = spec.batch_max_size
+            fslot = jnp.clip(first_rifl - 1, 0, CT - 1)
+            count = st.c_batch_count[c, fslot] if B > 1 else jnp.int32(1)
+            for b_i in range(max(B, 1)):
+                rslot = jnp.clip(first_rifl - 1 + b_i, 0, CT - 1)
+                lat = st.now - st.c_sub_time[c, rslot]
+                st = _record_latency(env, st, c, lat, enable=(b_i < count))
+            resp = st.c_resp[c] + count
             st = st._replace(c_resp=st.c_resp.at[c].set(resp))
             newly_done = (resp >= spec.commands_per_client) & ~st.c_done[c]
             return _mark_done(st, c, newly_done)
@@ -426,6 +454,7 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         st = _record_latency(env, st, c, lat)
         more = st.c_issued[c] < spec.commands_per_client
         keys, ro = _sample(env, st, c, st.c_issued[c])
+        keys = _pad_key_slots(keys)
         cand = _submit_candidate(env, st, c, st.c_issued[c] + 1, ro, keys)
         cand = cand._replace(valid=more[None])
         newly_done = ~more & ~st.c_done[c]
@@ -436,16 +465,23 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         st = _mark_done(st, c, newly_done)
         return _insert(st, cand)
 
+    def _pad_key_slots(keys):
+        """Pad a logical command's keys up to the KPC merged-slot width by
+        repeating the last key (duplicates change no conflict set)."""
+        kl = [keys[i] for i in range(keys.shape[0])]
+        while len(kl) < KPC:
+            kl.append(kl[-1])
+        return jnp.stack(kl)
+
     def _tick_branch(env, op):
-        """Open-loop interval tick: issue the next command now and schedule
-        the following tick (run/task/client/mod.rs:190)."""
+        """Open-loop interval tick: issue the next command now — through the
+        batcher when enabled — and schedule the following tick
+        (run/task/client/mod.rs:190; batcher.rs:15-60)."""
         st, src, dst, kind, payload = op
         c = payload[0]
         i = st.c_issued[c]
         more = i < spec.commands_per_client
         keys, ro = _sample(env, st, c, i)
-        sub = _submit_candidate(env, st, c, i + 1, ro, keys)
-        sub = sub._replace(valid=more[None])
         slot = jnp.clip(i, 0, st.c_sub_time.shape[1] - 1)
         st = st._replace(
             c_sub_time=st.c_sub_time.at[c, slot].set(
@@ -453,7 +489,42 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             ),
             c_issued=st.c_issued.at[c].add(more.astype(jnp.int32)),
         )
-        st = _insert(st, sub)
+        B = spec.batch_max_size
+        if B <= 1:
+            sub = _submit_candidate(env, st, c, i + 1, ro, _pad_key_slots(keys))
+            sub = sub._replace(valid=more[None])
+            st = _insert(st, sub)
+        else:
+            WKPC = KPC // B  # logical keys per command
+            cnt = st.b_cnt[c]
+            fresh = cnt == 0
+            first_rifl = jnp.where(fresh, i + 1, st.b_first_rifl[c])
+            first_time = jnp.where(fresh, st.now, st.b_first_time[c])
+            merged_ro = jnp.where(fresh, ro, st.b_ro[c] & ro)
+            kidx = jnp.arange(KPC, dtype=jnp.int32)
+            write = more & (kidx >= cnt * WKPC) & (kidx < (cnt + 1) * WKPC)
+            incoming = keys[jnp.clip(kidx - cnt * WKPC, 0, WKPC - 1)]
+            row = jnp.where(write, incoming, st.b_keys[c])
+            cnt2 = cnt + more.astype(jnp.int32)
+            last = (i + 1) >= spec.commands_per_client
+            aged = (st.now - first_time) >= spec.batch_max_delay_ms
+            flush = more & ((cnt2 >= B) | last | aged)
+            # pad unused slots with the last accumulated key
+            last_key = row[jnp.clip(cnt2 * WKPC - 1, 0, KPC - 1)]
+            send_keys = jnp.where(kidx < cnt2 * WKPC, row, last_key)
+            st = st._replace(
+                b_cnt=st.b_cnt.at[c].set(jnp.where(flush, 0, cnt2)),
+                b_first_rifl=st.b_first_rifl.at[c].set(first_rifl),
+                b_first_time=st.b_first_time.at[c].set(first_time),
+                b_keys=st.b_keys.at[c].set(row),
+                b_ro=st.b_ro.at[c].set(merged_ro),
+                c_batch_count=st.c_batch_count.at[
+                    c, jnp.clip(first_rifl - 1, 0, st.c_batch_count.shape[1] - 1)
+                ].set(jnp.where(flush, cnt2, 0)),
+            )
+            sub = _submit_candidate(env, st, c, first_rifl, merged_ro, send_keys)
+            sub = sub._replace(valid=flush[None])
+            st = _insert(st, sub)
         interval = spec.open_loop_interval_ms or 1
         tick = Candidates(
             valid=(more & ((i + 1) < spec.commands_per_client))[None],
@@ -590,6 +661,14 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             ),
             c_done=jnp.zeros((C,), jnp.bool_),
             c_got=jnp.zeros(
+                (C, spec.commands_per_client if OPEN else 1), jnp.int32
+            ),
+            b_cnt=jnp.zeros((C,), jnp.int32),
+            b_first_rifl=jnp.zeros((C,), jnp.int32),
+            b_first_time=jnp.zeros((C,), jnp.int32),
+            b_keys=jnp.zeros((C, KPC), jnp.int32),
+            b_ro=jnp.zeros((C,), jnp.bool_),
+            c_batch_count=jnp.zeros(
                 (C, spec.commands_per_client if OPEN else 1), jnp.int32
             ),
             clients_done=jnp.int32(0),

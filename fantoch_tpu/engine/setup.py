@@ -39,7 +39,14 @@ def build_spec(
     max_steps: int = 1 << 30,
     max_res: int = 4,
     open_loop_interval_ms: Optional[int] = None,
+    batch_max_size: int = 1,
+    batch_max_delay_ms: int = 0,
 ) -> SimSpec:
+    if batch_max_size > 1:
+        assert open_loop_interval_ms is not None, (
+            "batching needs open-loop clients (a closed loop has a single"
+            " outstanding command, so there is nothing to merge)"
+        )
     assert config.gc_interval_ms is not None, (
         "the simulator requires gc to be running (reference runner.rs:75)"
     )
@@ -85,7 +92,9 @@ def build_spec(
         max_seq=max_seq,
         pool_slots=pool_slots,
         hist_buckets=hist_buckets,
-        keys_per_command=workload.keys_per_command,
+        # merged-command key-slot count: protocols must be built with the
+        # same value (command_key_slots)
+        keys_per_command=command_key_slots(workload, batch_max_size),
         commands_per_client=workload.commands_per_client,
         proto_periodic_ms=tuple(proto_ms),
         proto_periodic_kinds=tuple(proto_kinds),
@@ -96,7 +105,15 @@ def build_spec(
         max_steps=max_steps,
         max_res=max_res,
         open_loop_interval_ms=open_loop_interval_ms,
+        batch_max_size=batch_max_size,
+        batch_max_delay_ms=batch_max_delay_ms,
     )
+
+
+def command_key_slots(workload: Workload, batch_max_size: int = 1) -> int:
+    """Key-slot count of a (possibly merged) protocol command — the
+    `keys_per_command` to build protocols with when batching is enabled."""
+    return workload.keys_per_command * batch_max_size
 
 
 @dataclasses.dataclass

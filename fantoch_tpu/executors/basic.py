@@ -16,7 +16,7 @@ from typing import NamedTuple
 import jax.numpy as jnp
 
 from ..engine.types import ExecutorDef
-from .ready import ReadyRing, ready_drain, ready_init, ready_push, writer_id
+from .ready import ReadyRing, ready_capacity, ready_drain, ready_init, ready_push, writer_id
 
 EXEC_WIDTH = 3
 
@@ -30,7 +30,7 @@ def make_executor(n: int) -> ExecutorDef:
     def init(spec, env):
         return BasicExecState(
             kvs=jnp.zeros((n, spec.key_space), jnp.int32),
-            ready=ready_init(n, max(2 * spec.n_clients, 8)),
+            ready=ready_init(n, ready_capacity(spec)),
         )
 
     def handle(ctx, est: BasicExecState, p, info, now):

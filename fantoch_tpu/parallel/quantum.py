@@ -31,6 +31,14 @@ same ordering as under the event engine.
 
 Constraints: `n == mesh axis size` (one process per device slice);
 single-shard; closed-loop clients.
+
+Known boundary difference vs the event engine: the engine's loop guard reads
+the previous event's time, so it processes exactly one event past
+`final_time`; the quantum runner stops before the first instant past
+`final_time`. The difference only affects post-completion bookkeeping
+traffic (late GC messages in the extra_ms drain window); client latencies
+are always recorded well before `final_time`. Equality tests use configs
+whose drain window is quiet at the boundary.
 """
 from __future__ import annotations
 
@@ -152,6 +160,7 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         "the distributed runner supports closed-loop clients only"
     )
     assert not spec.reorder, "message reordering is an event-engine mode"
+    assert spec.batch_max_size <= 1, "batching needs open-loop clients"
     n, C_TOTAL, S = spec.n, spec.n_clients, spec.pool_slots
     W = max(message_width(pdef, spec.keys_per_command), 4 + spec.keys_per_command)
     KPC = spec.keys_per_command
@@ -235,6 +244,7 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         isq = np.zeros((n, IP), np.int32)
         ik = np.zeros((n, IP), np.int32)
         ipay = np.zeros((n, IP, W), np.int32)
+        seed_key = jax.random.wrap_key_data(lenv.seed)
         for p in range(n):
             for s in range(CM):
                 if not bool(cl_present[p, s]):
@@ -245,6 +255,14 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
                 ik[p, s] = RK_SUBMIT
                 ipay[p, s, 0] = s  # local client slot
                 ipay[p, s, 1] = 1  # rifl 1
+                # first command's workload sample (matches the engine's
+                # init_state keys0/ro0, lockstep.py)
+                keys0, ro0 = workload_mod.sample_command_keys(
+                    consts, seed_key, jnp.int32(cl_gcid[p, s]), jnp.int32(0),
+                    lenv.conflict_rate, lenv.read_only_pct,
+                )
+                ipay[p, s, 2] = int(ro0)
+                ipay[p, s, 3 : 3 + KPC] = np.asarray(keys0)
         return RState(
             now=jnp.int32(0),
             all_done=jnp.bool_(False),
@@ -736,7 +754,19 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         myrow = jax.lax.axis_index(AXIS)
         L = Local(st_local, *empty_send(), cont=jnp.bool_(True))
         L = jax.lax.while_loop(lambda L: L.cont, lambda L: quantum(L, myrow), L)
-        return L.st
+        # 0-d leaves (overflow counters) are device-local but leave shard_map
+        # through a replicated P() out-spec: return their global sum so a
+        # single-device overflow can't vanish into an arbitrary shard's copy
+        st = L.st
+        def _sum_scalars(x):
+            if jnp.ndim(x) == 0 and jnp.issubdtype(x.dtype, jnp.integer):
+                return jax.lax.psum(x, AXIS)
+            return x
+        st = st._replace(
+            proto=jax.tree_util.tree_map(_sum_scalars, st.proto),
+            exec=jax.tree_util.tree_map(_sum_scalars, st.exec),
+        )
+        return st
 
     def run_sharded(mesh: Mesh, state: RState) -> RState:
         assert mesh.devices.size == n, (

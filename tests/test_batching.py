@@ -1,0 +1,83 @@
+"""Client-side batching (run/task/client/batcher.rs + Command::merge).
+
+Open-loop clients merge up to `batch_max_size` commands into one protocol
+command; the unbatcher completes every logical command of the batch with its
+own latency (measured from its issue tick, so earlier batch members pay the
+batching delay).
+"""
+import jax
+import numpy as np
+
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.planet import Planet
+from fantoch_tpu.core.workload import KeyGen, Workload
+from fantoch_tpu.engine import lockstep, setup, summary
+from fantoch_tpu.protocols import basic as basic_proto
+
+CMDS = 20
+
+
+def run_batched(batch_max_size, interval_ms=1, batch_max_delay_ms=50):
+    planet = Planet.new()
+    config = Config(n=3, f=1, gc_interval_ms=100)
+    wl = Workload(
+        shard_count=1,
+        key_gen=KeyGen.conflict_pool(conflict_rate=100, pool_size=1),
+        keys_per_command=1,
+        commands_per_client=CMDS,
+    )
+    pdef = basic_proto.make_protocol(
+        config.n, setup.command_key_slots(wl, batch_max_size)
+    )
+    spec = setup.build_spec(
+        config, wl, pdef, n_clients=2, n_client_groups=2,
+        extra_ms=1000, max_steps=5_000_000,
+        open_loop_interval_ms=interval_ms,
+        batch_max_size=batch_max_size,
+        batch_max_delay_ms=batch_max_delay_ms,
+    )
+    placement = setup.Placement(
+        ["asia-east1", "us-central1", "us-west1"], ["us-west1", "us-west2"], 1
+    )
+    env = setup.build_env(spec, config, planet, placement, wl, pdef)
+    st = jax.jit(lockstep.make_run(spec, pdef, wl))(env)
+    st = jax.tree_util.tree_map(np.asarray, st)
+    summary.check_sim_health(st)
+    return st, env
+
+
+def test_batching_completes_all_commands_with_fewer_dots():
+    B = 4
+    st, env = run_batched(B)
+    # every logical command answered
+    np.testing.assert_array_equal(st.c_resp, [CMDS, CMDS])
+    np.testing.assert_array_equal(st.lat_cnt, [CMDS, CMDS])
+    # but only CMDS/B protocol commands (dots) per client were agreed on
+    dots_used = int(st.next_seq.sum()) - 3  # next_seq starts at 1 per process
+    assert dots_used == 2 * CMDS // B, dots_used
+    commits = np.asarray(st.proto.commit_count)
+    assert (commits == 2 * CMDS // B).all(), commits
+    # earlier batch members pay up to (B-1) ticks of batching delay on top
+    # of the 34/58ms commit latency
+    mean1 = st.lat_sum[0] / st.lat_cnt[0]
+    mean2 = st.lat_sum[1] / st.lat_cnt[1]
+    assert 34.0 <= mean1 <= 34.0 + B - 1, mean1
+    assert 58.0 <= mean2 <= 58.0 + B - 1, mean2
+
+
+def test_batch_delay_flushes_partial_batches():
+    # with a huge batch size, only the age trigger (and the final-command
+    # flush) can flush; commands still all complete
+    st, env = run_batched(batch_max_size=8, interval_ms=5, batch_max_delay_ms=9)
+    np.testing.assert_array_equal(st.c_resp, [CMDS, CMDS])
+    # age trigger at 9ms with a 5ms tick flushes every ~3rd tick, so more
+    # than CMDS/8 dots were used
+    dots_used = int(st.next_seq.sum()) - 3
+    assert dots_used > 2 * CMDS // 8, dots_used
+
+
+def test_batch_of_one_matches_plain_open_loop():
+    st1, _ = run_batched(batch_max_size=1)
+    np.testing.assert_array_equal(st1.c_resp, [CMDS, CMDS])
+    assert st1.lat_sum[0] / st1.lat_cnt[0] == 34.0
+    assert st1.lat_sum[1] / st1.lat_cnt[1] == 58.0
