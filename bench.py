@@ -3,13 +3,14 @@
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-The headline metric is simulated protocol events/sec across a vmapped batch
-of independent configurations — the device analogue of the reference's
-rayon-parallel simulation sweep (`fantoch_ps/src/bin/simulation.rs`). The
-baseline for `vs_baseline` is a single-threaded Python evaluation rate of
-~50k events/sec/core, the right order for the reference's per-core
-discrete-event loop (heap pop + protocol handler per event); >1 means one
-chip beats one CPU core sweeping the same grid.
+The headline metric is simulated protocol events/sec across vmapped batches
+of independent configurations for three protocols (Basic, Tempo, Atlas) —
+the device analogue of the reference's rayon-parallel simulation sweep
+(`fantoch_ps/src/bin/simulation.rs`). The baseline for `vs_baseline` is a
+single-threaded evaluation rate of ~50k events/sec/core, the right order
+for the reference's per-core discrete-event loop (heap pop + protocol
+handler per event); >1 means one chip beats one CPU core sweeping the same
+grid. Per-protocol breakdown goes to stderr.
 """
 import json
 import os
@@ -25,19 +26,26 @@ from fantoch_tpu.core.config import Config
 from fantoch_tpu.core.planet import Planet
 from fantoch_tpu.core.workload import KeyGen, Workload
 from fantoch_tpu.engine import setup, sweep
+from fantoch_tpu.protocols import atlas as atlas_proto
 from fantoch_tpu.protocols import basic as basic_proto
+from fantoch_tpu.protocols import tempo as tempo_proto
 
 # reference-scale single-core event rate (discrete-event loop on a modern
 # x86 core; see BASELINE.md — the reference publishes no absolute numbers, so
 # the sweep-throughput baseline is per-core event processing)
 BASELINE_EVENTS_PER_SEC = 50_000.0
 
+PLACEMENT = setup.Placement(
+    ["asia-east1", "us-central1", "us-west1"], ["us-west1", "us-west2"], 2
+)
 
-def build_batch(n_configs: int, commands_per_client: int = 50):
+
+def build_batch(pdef, n_configs, commands_per_client, conflict_rate=50):
     planet = Planet.new()
     config = Config(n=3, f=1, gc_interval_ms=100)
-    workload = Workload(1, KeyGen.conflict_pool(100, 1), 1, commands_per_client, 100)
-    pdef = basic_proto.make_protocol(config.n, 1)
+    workload = Workload(
+        1, KeyGen.conflict_pool(conflict_rate, 2), 1, commands_per_client, 100
+    )
     C = 4
     spec = setup.build_spec(
         config,
@@ -47,30 +55,26 @@ def build_batch(n_configs: int, commands_per_client: int = 50):
         n_client_groups=2,
         max_steps=5_000_000,
         extra_ms=1000,
+        # tight in-flight bound: C closed-loop clients keep ~3n messages in
+        # flight each plus GC fan-out; a small pool keeps the [B, S] pool
+        # scatters (the per-event hot ops) cheap on-chip
+        pool_slots=128,
     )
-    placement = setup.Placement(
-        ["asia-east1", "us-central1", "us-west1"], ["us-west1", "us-west2"], 2
-    )
-    envs = []
-    for i in range(n_configs):
-        envs.append(
-            setup.build_env(spec, config, planet, placement, workload, pdef, seed=i)
-        )
-    return spec, pdef, workload, sweep.stack_envs(envs)
+    envs = [
+        setup.build_env(spec, config, planet, PLACEMENT, workload, pdef, seed=i)
+        for i in range(n_configs)
+    ]
+    return spec, workload, sweep.stack_envs(envs)
 
 
-def main():
-    n_configs = int(os.environ.get("BENCH_CONFIGS", "64"))
-    chunk_steps = int(os.environ.get("BENCH_CHUNK_STEPS", "20000"))
-    spec, pdef, wl, envs = build_batch(n_configs)
-
+def run_protocol(name, pdef, n_configs, commands_per_client, chunk_steps):
+    spec, wl, envs = build_batch(pdef, n_configs, commands_per_client)
     init, chunk, done = sweep.make_chunked_runner(spec, pdef, wl, chunk_steps)
-    # warm-up: compile both programs (init + chunk) on a throwaway state
+    # warm-up: compile both programs on a throwaway state
     warm = chunk(envs, init(envs))
     jax.block_until_ready(warm)
     del warm
 
-    # timed: a fresh full run, chunked until every config finishes
     t0 = time.time()
     st = init(envs)
     while not done(st):
@@ -79,17 +83,46 @@ def main():
     elapsed = time.time() - t0
 
     res = sweep.summarize_batch(st)
-    total_events = int(res["steps"].sum())
-    if not res["all_done"].all():
-        print(
-            json.dumps({"error": "simulation incomplete", "done": int(res["all_done"].sum())}),
-            file=sys.stderr,
+    events = int(res["steps"].sum())
+    ok = bool(res["all_done"].all())
+    print(
+        f"  {name}: {n_configs} configs, {events} events, "
+        f"{elapsed:.1f}s -> {events / elapsed:,.0f} events/sec"
+        + ("" if ok else "  [INCOMPLETE]"),
+        file=sys.stderr,
+    )
+    return events, elapsed, ok
+
+
+def main():
+    scale = float(os.environ.get("BENCH_SCALE", "1"))
+    chunk_steps = int(os.environ.get("BENCH_CHUNK_STEPS", "4000"))
+    n = 3
+    runs = [
+        # (name, pdef, configs, commands/client)
+        ("basic", basic_proto.make_protocol(n, 1), int(1024 * scale), 50),
+        ("tempo", tempo_proto.make_protocol(n, 1), int(256 * scale), 20),
+        ("atlas", atlas_proto.make_protocol(n, 1), int(64 * scale), 10),
+    ]
+    total_events, total_time = 0, 0.0
+    all_ok = True
+    for name, pdef, n_configs, cmds in runs:
+        events, elapsed, ok = run_protocol(
+            name, pdef, max(n_configs, 1), cmds, chunk_steps
         )
-    events_per_sec = total_events / max(elapsed, 1e-9)
+        total_events += events
+        total_time += elapsed
+        all_ok &= ok
+    if not all_ok:
+        print(json.dumps({"error": "simulation incomplete"}), file=sys.stderr)
+    events_per_sec = total_events / max(total_time, 1e-9)
     print(
         json.dumps(
             {
-                "metric": "simulated protocol events/sec/chip (Basic n=3, 64-config vmap sweep)",
+                "metric": (
+                    "simulated consensus events/sec/chip "
+                    "(Basic+Tempo+Atlas n=3 config sweeps)"
+                ),
                 "value": round(events_per_sec, 1),
                 "unit": "events/sec",
                 "vs_baseline": round(events_per_sec / BASELINE_EVENTS_PER_SEC, 3),

@@ -47,6 +47,11 @@ def build_spec(
             "batching needs open-loop clients (a closed loop has a single"
             " outstanding command, so there is nothing to merge)"
         )
+        assert batch_max_delay_ms >= 1, (
+            "batching needs batch_max_delay_ms >= 1: with a 0 delay the age"
+            " trigger fires on every tick and every batch degenerates to one"
+            " command"
+        )
     assert config.gc_interval_ms is not None, (
         "the simulator requires gc to be running (reference runner.rs:75)"
     )

@@ -244,7 +244,17 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         isq = np.zeros((n, IP), np.int32)
         ik = np.zeros((n, IP), np.int32)
         ipay = np.zeros((n, IP, W), np.int32)
+        # first command's workload sample for every slot in one vmapped
+        # dispatch (matches the engine's init_state keys0/ro0, lockstep.py)
         seed_key = jax.random.wrap_key_data(lenv.seed)
+        keys0, ro0 = jax.vmap(
+            lambda g: workload_mod.sample_command_keys(
+                consts, seed_key, g, jnp.int32(0),
+                lenv.conflict_rate, lenv.read_only_pct,
+            )
+        )(jnp.asarray(cl_gcid.reshape(-1)))
+        keys0 = np.asarray(keys0).reshape(n, CM, KPC)
+        ro0 = np.asarray(ro0).reshape(n, CM)
         for p in range(n):
             for s in range(CM):
                 if not bool(cl_present[p, s]):
@@ -255,14 +265,8 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
                 ik[p, s] = RK_SUBMIT
                 ipay[p, s, 0] = s  # local client slot
                 ipay[p, s, 1] = 1  # rifl 1
-                # first command's workload sample (matches the engine's
-                # init_state keys0/ro0, lockstep.py)
-                keys0, ro0 = workload_mod.sample_command_keys(
-                    consts, seed_key, jnp.int32(cl_gcid[p, s]), jnp.int32(0),
-                    lenv.conflict_rate, lenv.read_only_pct,
-                )
-                ipay[p, s, 2] = int(ro0)
-                ipay[p, s, 3 : 3 + KPC] = np.asarray(keys0)
+                ipay[p, s, 2] = int(ro0[p, s])
+                ipay[p, s, 3 : 3 + KPC] = keys0[p, s]
         return RState(
             now=jnp.int32(0),
             all_done=jnp.bool_(False),
