@@ -133,6 +133,129 @@ def cmd_plot(args) -> int:
     return 0
 
 
+def cmd_sequencer_bench(args) -> int:
+    """Micro-bench of the per-key clock sequencer (the reference's
+    `fantoch_ps/src/bin/sequencer_bench.rs` measures KeyClocks proposal
+    throughput across its Sequential/Atomic/Locked variants; on device the
+    variants collapse into one vmapped kernel — the batch axis is the
+    concurrency)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    K, B, R = args.keys, args.batch, args.rounds
+
+    def one_lane(seed):
+        key = jax.random.key(seed)
+
+        def step(carry, i):
+            clocks, key = carry
+            key, k1 = jax.random.split(key)
+            ks = jax.random.randint(k1, (args.keys_per_command,), 0, K)
+            # KeyClocks::proposal: clock = max over keys + 1, bump each key
+            cur = clocks[ks].max()
+            clock = cur + 1
+            clocks = clocks.at[ks].max(clock)
+            return (clocks, key), clock
+
+        (clocks, _), clks = jax.lax.scan(
+            step, (jnp.zeros((K,), jnp.int32), key), jnp.arange(R)
+        )
+        return clocks, clks.max()
+
+    fn = jax.jit(jax.vmap(one_lane))
+    seeds = jnp.arange(B)
+    jax.block_until_ready(fn(seeds))  # compile
+    t0 = time.time()
+    out = fn(seeds)
+    jax.block_until_ready(out)
+    dt = time.time() - t0
+    total = B * R
+    print(
+        json.dumps(
+            {
+                "proposals": total,
+                "keys": K,
+                "lanes": B,
+                "proposals_per_sec": round(total / dt, 1),
+            }
+        )
+    )
+    return 0
+
+
+def cmd_replay(args) -> int:
+    """Re-feed a dependency stream through a fresh graph executor (the
+    reference's `fantoch_ps/src/bin/graph_executor_replay.rs` replays an
+    execution log); `--demo` synthesizes a random committed stream."""
+    import numpy as np
+
+    from .exp.harness import replay_graph_stream
+
+    if not args.demo and not args.log:
+        print("replay: pass --log FILE or --demo N", file=sys.stderr)
+        return 2
+    if args.demo:
+        rng = np.random.default_rng(args.seed)
+        dots = args.demo
+        rows = []
+        for d in rng.permutation(dots):
+            deps = rng.choice(dots, size=rng.integers(0, 3), replace=False)
+            rows.append([int(d)] + [int(x) for x in deps])
+    else:
+        with open(args.log) as f:
+            rows = json.load(f)
+    if not rows or any(not r for r in rows):
+        print("replay: log must be a non-empty list of [dot, dep...] rows",
+              file=sys.stderr)
+        return 2
+    out = replay_graph_stream(rows, n=1)
+    print(json.dumps(out))
+    return 0
+
+
+def cmd_shard_distribution(args) -> int:
+    """How many shards zipf-generated commands span (the reference's
+    `fantoch_ps/src/bin/shard_distribution.rs`)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .core.workload import KeyGen, Workload, WorkloadConsts, sample_command_keys
+
+    wl = Workload(
+        shard_count=args.shards,
+        key_gen=KeyGen.zipf(args.coefficient, args.keys_per_shard),
+        keys_per_command=args.keys_per_command,
+        commands_per_client=1,
+    )
+    consts = WorkloadConsts.build(wl)
+    key = jax.random.key(args.seed)
+
+    def one(i):
+        ks, _ = sample_command_keys(
+            consts, key, i, jnp.int32(0), jnp.int32(0), jnp.int32(0)
+        )
+        return ks % args.shards
+
+    shards = np.asarray(jax.jit(jax.vmap(one))(jnp.arange(args.commands)))
+    spans = np.asarray([len(set(row.tolist())) for row in shards])
+    per_shard = np.bincount(shards.reshape(-1), minlength=args.shards)
+    print(
+        json.dumps(
+            {
+                "commands": args.commands,
+                "span_histogram": {
+                    int(s): int((spans == s).sum()) for s in np.unique(spans)
+                },
+                "per_shard_keys": per_shard.tolist(),
+            }
+        )
+    )
+    return 0
+
+
 def cmd_bote(args) -> int:
     from .core.planet import Planet
     from .planner.bote import Bote, RankingParams, Search
@@ -191,6 +314,34 @@ def main(argv=None) -> int:
     pp.add_argument("--results", default="results")
     pp.add_argument("--out", default="plots")
     pp.set_defaults(fn=cmd_plot)
+
+    pq = sub.add_parser(
+        "sequencer-bench", help="per-key clock sequencer micro-bench"
+    )
+    pq.add_argument("--keys", type=int, default=1024)
+    pq.add_argument("--batch", type=int, default=256)
+    pq.add_argument("--rounds", type=int, default=1024)
+    pq.add_argument("--keys-per-command", type=int, default=2)
+    pq.set_defaults(fn=cmd_sequencer_bench)
+
+    pr = sub.add_parser(
+        "replay", help="re-run a dependency stream through the graph executor"
+    )
+    pr.add_argument("--log", default="", help="JSON file: [[dot, dep...], ...]")
+    pr.add_argument("--demo", type=int, default=0, help="synthesize N dots")
+    pr.add_argument("--seed", type=int, default=0)
+    pr.set_defaults(fn=cmd_replay)
+
+    pd = sub.add_parser(
+        "shard-distribution", help="zipf command shard-span analysis"
+    )
+    pd.add_argument("--shards", type=int, default=2)
+    pd.add_argument("--keys-per-shard", type=int, default=1000)
+    pd.add_argument("--coefficient", type=float, default=1.0)
+    pd.add_argument("--keys-per-command", type=int, default=2)
+    pd.add_argument("--commands", type=int, default=10000)
+    pd.add_argument("--seed", type=int, default=0)
+    pd.set_defaults(fn=cmd_shard_distribution)
 
     pb = sub.add_parser("bote", help="closed-form config-space planner search")
     pb.add_argument("--ns", default="3,5")

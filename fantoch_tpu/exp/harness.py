@@ -244,3 +244,58 @@ def run_grid(
         if verbose:
             print(f"bucket {bi} ({bkey}) -> {out_dirs[-1]}", flush=True)
     return out_dirs
+
+
+def replay_graph_stream(rows: Sequence[Sequence[int]], n: int = 1) -> dict:
+    """Re-run a committed-dependency stream through a fresh graph executor
+    (the reference's `graph_executor_replay` binary re-feeds an execution
+    log, `fantoch_ps/src/bin/graph_executor_replay.rs:13-38`).
+
+    `rows` are `[dot, dep, dep, ...]` commit records in arrival order.
+    Returns the induced execution order and chain metrics.
+    """
+    import types
+
+    import jax.numpy as jnp
+
+    from ..engine.types import CmdView, Ctx
+    from ..executors import graph as graph_executor
+
+    dots = max(r[0] for r in rows) + 1
+    D = max(1, max(len(r) - 1 for r in rows))
+    spec = types.SimpleNamespace(
+        dots=dots,
+        key_space=1,
+        keys_per_command=1,
+        n_clients=1,
+        commands_per_client=dots,
+        max_res=4,
+    )
+    exdef = graph_executor.make_executor(n, D)
+    estate = exdef.init(spec, None)
+    cmds = CmdView(
+        client=jnp.zeros((dots,), jnp.int32),
+        rifl_seq=jnp.arange(1, dots + 1, dtype=jnp.int32),
+        keys=jnp.zeros((dots, 1), jnp.int32),
+        read_only=jnp.zeros((dots,), jnp.bool_),
+    )
+    ctx = Ctx(spec=spec, env=None, cmds=cmds, pid=jnp.int32(0))
+
+    infos = np.zeros((len(rows), 1 + D), np.int32)
+    for i, r in enumerate(rows):
+        infos[i, 0] = r[0]
+        for j, dep in enumerate(r[1:]):
+            infos[i, 1 + j] = dep + 1  # flat dot + 1, 0 = empty
+
+    def step(est, info):
+        return exdef.handle(ctx, est, jnp.int32(0), info, jnp.int32(0)), None
+
+    estate, _ = jax.lax.scan(step, estate, jnp.asarray(infos))
+    pushed = int(estate.ready.push[0])
+    order = [int(x) - 1 for x in np.asarray(estate.ready.rifl_seq[0])[:pushed]]
+    return {
+        "committed": len(rows),
+        "executed": order,
+        "executed_count": int(estate.executed_count[0]),
+        "chain_max": int(estate.chain_max[0]),
+    }

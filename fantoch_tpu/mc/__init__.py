@@ -1,0 +1,1 @@
+from .checker import SynodModel, check_agreement  # noqa: F401

@@ -1,0 +1,249 @@
+"""Model checker: exhaustive exploration of the synod slow path.
+
+The reference ships a stateright adapter that was never finished
+(`fantoch_mc/src/lib.rs:14-83`, excluded from the workspace); its working
+verification is a quickcheck property over random action sequences
+(`fantoch_ps/src/protocol/common/synod/single.rs:709-819`). This module goes
+further: a breadth-first *exhaustive* search over every reachable state of a
+small synod system, driving the framework's actual handler code
+(protocols/common/synod.py) — not an abstract model of it.
+
+TPU-style division of labor: successor expansion is one vmapped pure
+function (`frontier [F, SW] -> [F, T, SW]` over every (message, receiver)
+transition), so the heavy branching runs as a single device dispatch per
+BFS level; the host only deduplicates states (np.unique) against the
+visited set.
+
+System model (standard for Paxos checking): the network is a monotone set
+of sent messages — any sent message can be delivered to any process any
+number of times, in any order, or never (loss = never delivered); this
+subsumes reordering and duplication. Two proposers compete for one decree:
+the dot's coordinator on the skipped-prepare ballot (its 1-based id) and a
+recovering proposer on a prepare ballot > n, each with a distinct initial
+value. The safety property is agreement: no reachable state has two
+different chosen values.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..protocols.common import synod as sy
+
+
+@dataclasses.dataclass(frozen=True)
+class SynodModel:
+    """A small synod system: n acceptors, two competing proposers."""
+
+    n: int = 3
+    f: int = 1
+    # proposer 0: the coordinator, ballot = id (skipped prepare)
+    coord: int = 0
+    coord_value: int = 2
+    # proposer 1: a recovering process, prepare ballot > n
+    rec: int = 1
+    rec_value: int = 3
+    # guard knobs for checker self-validation (mutations reintroduce known
+    # paxos bugs; the checker must then FIND a violation)
+    break_accept_guard: bool = False  # acceptor accepts any ballot
+    break_adoption: bool = False  # recovery proposes its own value blindly
+
+    @property
+    def wq(self) -> int:
+        return self.f + 1
+
+    @property
+    def rec_ballot(self) -> int:
+        return self.n + self.rec + 1
+
+    @property
+    def values(self) -> Tuple[int, int]:
+        return (self.coord_value, self.rec_value)
+
+    @property
+    def ballots(self) -> Tuple[int, int]:
+        return (self.coord + 1, self.rec_ballot)
+
+
+def _message_space(m: SynodModel):
+    """Enumerate (kind, a, b, receiver) transition tuples and the network
+    bit of each sendable message. Kinds: 0=MAccept(bal, val)->acceptor,
+    1=MAccepted(bal)->its proposer, 2=MPrepare->acceptor,
+    3=MPromise(abal, aval)->recovering proposer."""
+    msgs = []  # message identity (kind, a, b) -> network bit
+    deliveries = []  # (msg_bit, kind, a, b, receiver)
+    bit = {}
+
+    def mbit(key):
+        if key not in bit:
+            bit[key] = len(bit)
+        return bit[key]
+
+    for bal in m.ballots:
+        for val in m.values:
+            mb = mbit(("accept", bal, val))
+            for p in range(m.n):
+                deliveries.append((mb, 0, bal, val, p))
+    for bal in m.ballots:
+        owner = m.coord if bal == m.coord + 1 else m.rec
+        for s in range(m.n):
+            mb = mbit(("accepted", bal, s))
+            deliveries.append((mb, 1, bal, s, owner))
+    mb = mbit(("prepare", m.rec_ballot))
+    for p in range(m.n):
+        deliveries.append((mb, 2, m.rec_ballot, 0, p))
+    for s in range(m.n):
+        for abal in [0] + list(m.ballots):
+            for aval in m.values if abal else [0]:
+                mb = mbit(("promise", s, abal, aval))
+                deliveries.append((mb, 3, abal, aval, m.rec, s))
+    return bit, deliveries
+
+
+# state vector layout: 9 synod fields x n + net bitmask + chosen bitmask
+def _state_width(n: int) -> int:
+    return 9 * n + 2
+
+
+def _pack(st: sy.SynodState, net, chosen):
+    cols = [getattr(st, f)[:, 0] for f in st._fields]
+    return jnp.concatenate([jnp.stack(cols).reshape(-1), net[None], chosen[None]])
+
+
+def _unpack(vec, n: int):
+    fields = vec[: 9 * n].reshape(9, n, 1)
+    st = sy.SynodState(*[fields[i] for i in range(9)])
+    return st, vec[9 * n], vec[9 * n + 1]
+
+
+def _expand_fn(m: SynodModel):
+    """One vmapped transition function: state vector -> [T, SW] successors
+    (invalid transitions return the unchanged state)."""
+    bits, deliveries = _message_space(m)
+    n = m.n
+    SW = _state_width(n)
+
+    def send(net, key, enable):
+        return jnp.where(enable, net | (1 << bits[key]), net)
+
+    def apply_one(vec, delivery):
+        mb, kind, a, b, recv = delivery[:5]
+        st, net, chosen = _unpack(vec, n)
+        present = (net >> mb) & 1 == 1
+        p = jnp.int32(recv)
+        dot = jnp.int32(0)
+
+        if kind == 0:  # MAccept(bal=a, val=b) at acceptor `recv`
+            st2, ok = sy.handle_accept(st, p, dot, jnp.int32(a), jnp.int32(b))
+            if m.break_accept_guard:
+                # mutation: accept unconditionally (drops the promised-ballot
+                # guard) — the checker must catch the resulting disagreement
+                st2 = st._replace(
+                    acc_bal=st.acc_bal.at[p, dot].set(jnp.int32(a)),
+                    acc_abal=st.acc_abal.at[p, dot].set(jnp.int32(a)),
+                    acc_val=st.acc_val.at[p, dot].set(jnp.int32(b)),
+                )
+                ok = jnp.bool_(True)
+            net2 = send(net, ("accepted", a, recv), ok)
+        elif kind == 1:  # MAccepted(bal=a, src=b) at its proposer
+            st2, ch, _val = sy.handle_accepted(
+                st, p, dot, jnp.int32(a), m.wq, jnp.int32(b)
+            )
+            val = st.prop_val[p, dot]
+            vbit = jnp.where(val == m.coord_value, 1, 2)
+            chosen2 = jnp.where(ch, chosen | vbit, chosen)
+            return jnp.where(
+                present, _pack(st2, net, chosen2), vec
+            )
+        elif kind == 2:  # MPrepare at acceptor `recv`
+            st2, ok, abal, aval = sy.handle_prepare(st, p, dot, jnp.int32(a))
+            net2 = net
+            for pa in [0] + list(m.ballots):
+                for pv in m.values if pa else [0]:
+                    net2 = send(
+                        net2, ("promise", recv, pa, pv),
+                        ok & (abal == pa) & (aval == pv),
+                    )
+        else:  # kind == 3: MPromise(abal=a, aval=b, src) at the recoverer
+            psrc = jnp.int32(delivery[5])
+            if m.break_adoption:
+                # mutation: ignore reported accepted values, always propose
+                # our own — classic prepare-phase bug
+                st2, start, _ = sy.handle_promise(
+                    st, p, dot, jnp.int32(m.rec_ballot), jnp.int32(0),
+                    jnp.int32(0), jnp.int32(m.rec_value), m.wq, psrc,
+                )
+            else:
+                st2, start, _ = sy.handle_promise(
+                    st, p, dot, jnp.int32(m.rec_ballot), jnp.int32(a),
+                    jnp.int32(b), jnp.int32(m.rec_value), m.wq, psrc,
+                )
+            net2 = net
+            for val in m.values:
+                net2 = send(
+                    net2, ("accept", m.rec_ballot, val),
+                    start & (st2.prop_val[p, dot] == val),
+                )
+        new_vec = _pack(st2, net2, chosen)
+        return jnp.where(present, new_vec, vec)
+
+    def expand(vec):
+        return jnp.stack([apply_one(vec, d) for d in deliveries])
+
+    return bits, deliveries, jax.jit(jax.vmap(expand))
+
+
+def _initial_state(m: SynodModel):
+    # coordinator skip-prepares its value; recovering proposer has sent its
+    # prepare — both initial messages are already in the network
+    n = m.n
+    st = sy.synod_init(n, 1)
+    st = sy.skip_prepare(st, m.coord, 0, jnp.int32(m.coord_value), pid=m.coord)
+    st = sy.prepare(st, m.rec, 0, jnp.int32(m.rec_ballot))
+    bitmap, _ = _message_space(m)
+    net = 0
+    net |= 1 << bitmap[("accept", m.coord + 1, m.coord_value)]
+    net |= 1 << bitmap[("prepare", m.rec_ballot)]
+    return _pack(st, jnp.int32(net), jnp.int32(0))
+
+
+def check_agreement(
+    model: Optional[SynodModel] = None, max_levels: int = 64
+) -> dict:
+    """Exhaustive BFS; returns {states, levels, violation: bool}."""
+    m = model or SynodModel()
+    _, _, expand = _expand_fn(m)
+    n = m.n
+    SW = _state_width(n)
+
+    def rowkeys(arr):
+        arr = np.ascontiguousarray(arr)
+        return arr.view(f"V{arr.dtype.itemsize * SW}").ravel()
+
+    frontier = np.asarray(_initial_state(m), np.int32)[None, :]
+    visited = rowkeys(frontier)
+    total = 1
+    for level in range(max_levels):
+        # chosen bitmask 3 = both values chosen somewhere on this path
+        if (frontier[:, SW - 1] == 3).any():
+            return {"states": total, "levels": level, "violation": True}
+        # pad the frontier to a power-of-two bucket (duplicate rows are
+        # harmless — successors dedup) so each bucket compiles once
+        F = len(frontier)
+        bucket = 1 << (F - 1).bit_length()
+        padded = np.concatenate(
+            [frontier, np.broadcast_to(frontier[:1], (bucket - F, SW))]
+        )
+        succ = np.asarray(expand(jnp.asarray(padded)), np.int32)
+        succ = np.unique(succ.reshape(-1, SW), axis=0)
+        fresh = succ[~np.isin(rowkeys(succ), visited)]
+        if not len(fresh):
+            return {"states": total, "levels": level, "violation": False}
+        visited = np.concatenate([visited, rowkeys(fresh)])
+        total += len(fresh)
+        frontier = fresh
+    raise RuntimeError(f"state space not exhausted in {max_levels} levels")

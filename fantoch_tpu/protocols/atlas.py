@@ -289,7 +289,7 @@ def _make(variant: str, n: int, keys_per_command: int, nfr: bool) -> ProtocolDef
         dot, ballot = payload[0], payload[1]
         not_committed = st.status[p, dot] != COMMIT
         sy, chosen, _ = synod_mod.handle_accepted(
-            st.synod, p, dot, ballot, ctx.env.wq_size
+            st.synod, p, dot, ballot, ctx.env.wq_size, src
         )
         chosen = chosen & not_committed
         st = st._replace(synod=sy)

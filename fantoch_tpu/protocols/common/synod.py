@@ -26,6 +26,7 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 
 
@@ -34,15 +35,22 @@ class SynodState(NamedTuple):
     acc_bal: jnp.ndarray  # [n, DOTS] int32 promised ballot (0 = none)
     acc_abal: jnp.ndarray  # [n, DOTS] int32 ballot of accepted value (0 = none)
     acc_val: jnp.ndarray  # [n, DOTS] int32 current consensus value
-    # proposer (single.rs Proposer)
+    # proposer (single.rs Proposer — `accepts`/`promises` are keyed by
+    # sender there, so both quorums are sender *bitmasks* here: duplicate
+    # deliveries of one process's reply must not advance a quorum, an
+    # invariant the model checker (mc/) exercises under message duplication)
     prop_bal: jnp.ndarray  # [n, DOTS] int32 ballot in use (0 = none)
     prop_val: jnp.ndarray  # [n, DOTS] int32 value proposed at prop_bal
-    prop_acks: jnp.ndarray  # [n, DOTS] int32 accepts on prop_bal
+    prop_acks: jnp.ndarray  # [n, DOTS] int32 bitmask of accepting senders
+    # prepare-phase proposer bookkeeping (single.rs Proposer: promises)
+    prom_mask: jnp.ndarray  # [n, DOTS] int32 bitmask of promising senders
+    prom_abal: jnp.ndarray  # [n, DOTS] int32 highest accepted ballot reported
+    prom_aval: jnp.ndarray  # [n, DOTS] int32 its value
 
 
 def synod_init(n: int, dots: int) -> SynodState:
     z = jnp.zeros((n, dots), jnp.int32)
-    return SynodState(z, z, z, z, z, z)
+    return SynodState(z, z, z, z, z, z, z, z, z)
 
 
 def set_if_not_accepted(sy: SynodState, p, dot, value, enable=True) -> SynodState:
@@ -87,10 +95,81 @@ def handle_accept(sy: SynodState, p, dot, ballot, value):
     return sy, ok
 
 
-def handle_accepted(sy: SynodState, p, dot, ballot, write_quorum_size):
-    """Proposer side of `MAccepted`: returns (state, chosen: bool, value)."""
+def handle_accepted(sy: SynodState, p, dot, ballot, write_quorum_size, src):
+    """Proposer side of `MAccepted` from `src`: (state, chosen, value).
+    Quorum membership is by sender, so re-delivery cannot double-count
+    (single.rs `Accepts` is a process-id set)."""
     match = sy.prop_bal[p, dot] == ballot
-    acks = sy.prop_acks[p, dot] + match.astype(jnp.int32)
-    chosen = match & (acks == write_quorum_size)
+    new = match & (((sy.prop_acks[p, dot] >> src) & 1) == 0)
+    acks = sy.prop_acks[p, dot] | jnp.where(new, jnp.int32(1) << src, 0)
+    count = jax.lax.population_count(acks.astype(jnp.uint32)).astype(jnp.int32)
+    chosen = new & (count == write_quorum_size)
     sy = sy._replace(prop_acks=sy.prop_acks.at[p, dot].set(acks))
     return sy, chosen, sy.prop_val[p, dot]
+
+
+# ---------------------------------------------------------------------------
+# prepare phase (recovery path; reference single.rs `handle_prepare` /
+# `handle_promise` — unexercised by the protocols, like the reference's, but
+# present for parity and exhaustively explored by the model checker, mc/)
+# ---------------------------------------------------------------------------
+
+
+def prepare(sy: SynodState, p, dot, ballot, enable=True) -> SynodState:
+    """Proposer starts a prepare round at `ballot` (must exceed n so it can
+    never collide with a skipped-prepare ballot; single.rs:87-92)."""
+    enable = jnp.asarray(enable)
+
+    def setw(a, v):
+        return a.at[p, dot].set(jnp.where(enable, v, a[p, dot]))
+
+    return sy._replace(
+        prop_bal=setw(sy.prop_bal, ballot),
+        prop_acks=setw(sy.prop_acks, 0),
+        prom_mask=setw(sy.prom_mask, 0),
+        prom_abal=setw(sy.prom_abal, 0),
+        prom_aval=setw(sy.prom_aval, 0),
+    )
+
+
+def handle_prepare(sy: SynodState, p, dot, ballot):
+    """Acceptor side of `MPrepare`: promise iff the ballot is higher than any
+    promised; returns (state, ok, accepted_ballot, accepted_value)."""
+    ok = ballot > sy.acc_bal[p, dot]
+    sy = sy._replace(
+        acc_bal=sy.acc_bal.at[p, dot].set(
+            jnp.where(ok, ballot, sy.acc_bal[p, dot])
+        )
+    )
+    return sy, ok, sy.acc_abal[p, dot], sy.acc_val[p, dot]
+
+
+def handle_promise(sy: SynodState, p, dot, ballot, abal, aval, initial_value,
+                   write_quorum_size, src):
+    """Proposer side of `MPromise` from `src`: track the highest reported
+    accepted (ballot, value); once a write quorum of distinct senders has
+    promised, move to the accept phase proposing the adopted value — the
+    reported value at the highest accepted ballot, or `initial_value` if
+    none was accepted (single.rs `Promises` keyed by process id). Returns
+    (state, start_accept: bool, value)."""
+    match = sy.prop_bal[p, dot] == ballot
+    new = match & (((sy.prom_mask[p, dot] >> src) & 1) == 0)
+    mask = sy.prom_mask[p, dot] | jnp.where(new, jnp.int32(1) << src, 0)
+    count = jax.lax.population_count(mask.astype(jnp.uint32)).astype(jnp.int32)
+    adopt = new & (abal > sy.prom_abal[p, dot])
+    prom_abal = jnp.where(adopt, abal, sy.prom_abal[p, dot])
+    prom_aval = jnp.where(adopt, aval, sy.prom_aval[p, dot])
+    start = new & (count == write_quorum_size)
+    value = jnp.where(prom_abal > 0, prom_aval, initial_value)
+    sy = sy._replace(
+        prom_mask=sy.prom_mask.at[p, dot].set(mask),
+        prom_abal=sy.prom_abal.at[p, dot].set(prom_abal),
+        prom_aval=sy.prom_aval.at[p, dot].set(prom_aval),
+        prop_val=sy.prop_val.at[p, dot].set(
+            jnp.where(start, value, sy.prop_val[p, dot])
+        ),
+        prop_acks=sy.prop_acks.at[p, dot].set(
+            jnp.where(start, 0, sy.prop_acks[p, dot])
+        ),
+    )
+    return sy, start, value

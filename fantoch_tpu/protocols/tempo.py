@@ -458,7 +458,7 @@ def make_protocol(
         dot, ballot = payload[0], payload[1]
         not_committed = st.status[p, dot] != COMMIT
         sy, chosen, value = synod_mod.handle_accepted(
-            st.synod, p, dot, ballot, ctx.env.wq_size
+            st.synod, p, dot, ballot, ctx.env.wq_size, src
         )
         chosen = chosen & not_committed
         st = st._replace(synod=sy)
