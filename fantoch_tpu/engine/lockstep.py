@@ -53,7 +53,7 @@ from .types import (
 class SimSpec:
     """Static shape-bucket parameters of one simulation compile."""
 
-    n: int  # processes
+    n: int  # total processes (ranks_per_shard x shards)
     n_clients: int
     n_client_groups: int  # latency-histogram groups (client regions)
     key_space: int
@@ -72,6 +72,11 @@ class SimSpec:
     reorder: bool  # random ×[0,10) message delay multiplier (sim_test mode)
     max_steps: int
     max_res: int  # executor results drained per call
+    # partial replication (reference `Command.shard_to_ops` + shard-aware
+    # routing): keys map to shards as key % shards; a command's target shard
+    # is its first key's (workload.rs:154-185); protocol traffic stays inside
+    # each shard (Env.all_mask is the per-process shard-member mask)
+    shards: int = 1
     # open-loop clients: issue on an interval tick instead of on reply
     # (run/task/client/mod.rs:190 open_loop_client); None = closed loop
     open_loop_interval_ms: Optional[int] = None
@@ -103,14 +108,16 @@ class Env(NamedTuple):
 
     dist_pp: jnp.ndarray  # [n, n] int32, one-way delay (ping//2)
     dist_pc: jnp.ndarray  # [n, C] int32 process->client delay
-    dist_cp: jnp.ndarray  # [C] int32 client->its coordinator delay
-    client_proc: jnp.ndarray  # [C] int32 coordinator process per client
+    dist_cp: jnp.ndarray  # [C, SHARDS] int32 client->connected process delay
+    client_proc: jnp.ndarray  # [C, SHARDS] int32 connected process per shard
     client_group: jnp.ndarray  # [C] int32 histogram group (client region)
     sorted_procs: jnp.ndarray  # [n, n] int32 processes sorted by distance per process
     fq_mask: jnp.ndarray  # [n] int32 fast-quorum bitmask per process
     wq_mask: jnp.ndarray  # [n] int32 write-quorum bitmask per process
     maj_mask: jnp.ndarray  # [n] int32 majority-quorum bitmask per process
-    all_mask: jnp.ndarray  # int32 (1<<n)-1
+    all_mask: jnp.ndarray  # [n] int32 per-process shard-member bitmask
+    shard_of: jnp.ndarray  # [n] int32 shard of each process
+    closest_shard_proc: jnp.ndarray  # [n, SHARDS] int32 closest member of each shard
     f: jnp.ndarray  # int32
     fq_size: jnp.ndarray  # int32
     wq_size: jnp.ndarray  # int32
@@ -308,7 +315,7 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         # command registered in its Pending (`runner.rs:351-362` wait_for) —
         # results elsewhere are dropped (`add_executor_result` -> None)
         cclip = jnp.clip(res.client, 0, C - 1)
-        valid = res.valid & (env.client_proc[cclip] == p)
+        valid = res.valid & (env.client_proc[cclip, env.shard_of[p]] == p)
         res = res._replace(valid=valid)
         cidx = jnp.where(valid, res.client, C)
         # partial results are tracked per outstanding command (AggregatePending,
@@ -414,17 +421,20 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
 
     def _submit_candidate(env, st, c, rifl, ro, keys):
         # `keys` is a list/array of KPC merged key slots (a single logical
-        # command pads its slots by repeating the last key)
+        # command pads its slots by repeating the last key); the command's
+        # target shard is its first key's (workload.rs:154-185), so it is
+        # submitted to the client's connected process in that shard
         payload_row = _pad_payload(
             [c[None], rifl[None], ro.astype(jnp.int32)[None]]
             + [keys[i][None] for i in range(KPC)],
             1,
         )
+        tshard = keys[0] % spec.shards
         return Candidates(
             valid=jnp.ones((1,), jnp.bool_),
-            time=(st.now + _delay(st, env, env.dist_cp[c][None])),
+            time=(st.now + _delay(st, env, env.dist_cp[c, tshard][None])),
             src=c[None],
-            dst=env.client_proc[c][None],
+            dst=env.client_proc[c, tshard][None],
             kind=jnp.full((1,), KIND_SUBMIT, jnp.int32),
             payload=payload_row,
         )
@@ -626,6 +636,7 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         )(clients)
         # closed loop: initial submits occupy pool slots 0..C-1;
         # open loop: the slots hold the first interval ticks instead
+        tshard0 = keys0[:, 0] % spec.shards
         payload0 = jnp.zeros((S, W), jnp.int32)
         payload0 = payload0.at[:C, 0].set(clients)
         if not OPEN:
@@ -639,12 +650,14 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             dropped=jnp.int32(0),
             m_valid=jnp.arange(S) < C,
             m_time=jnp.zeros((S,), jnp.int32).at[:C].set(
-                jnp.zeros((C,), jnp.int32) if OPEN else env.dist_cp
+                jnp.zeros((C,), jnp.int32)
+                if OPEN
+                else env.dist_cp[clients, tshard0]
             ),
             m_seq=jnp.arange(S, dtype=jnp.int32),
             m_src=jnp.zeros((S,), jnp.int32).at[:C].set(clients),
             m_dst=jnp.zeros((S,), jnp.int32).at[:C].set(
-                clients if OPEN else env.client_proc
+                clients if OPEN else env.client_proc[clients, tshard0]
             ),
             m_kind=jnp.full((S,), KIND_TICK if OPEN else KIND_SUBMIT, jnp.int32),
             m_payload=payload0,
@@ -687,7 +700,9 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             # (open-loop initial ticks are client-local, no network delay)
             key = jax.random.fold_in(jax.random.wrap_key_data(env.seed), 0x7FFFFFFF)
             u = jax.random.uniform(key, (C,), minval=0.0, maxval=10.0)
-            t0 = jnp.floor(env.dist_cp.astype(jnp.float32) * u).astype(jnp.int32)
+            t0 = jnp.floor(
+                env.dist_cp[clients, tshard0].astype(jnp.float32) * u
+            ).astype(jnp.int32)
             st = st._replace(m_time=st.m_time.at[:C].set(t0))
         return st
 

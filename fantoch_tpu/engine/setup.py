@@ -55,6 +55,13 @@ def build_spec(
     assert config.gc_interval_ms is not None, (
         "the simulator requires gc to be running (reference runner.rs:75)"
     )
+    n_total = config.n * config.shard_count
+    assert pdef.shards == config.shard_count, (
+        f"protocol {pdef.name} instance was built for {pdef.shards} shard(s)"
+        f" but the config has {config.shard_count}; pass shards= to the"
+        " protocol factory (protocols without the factory argument do not"
+        " support partial replication yet)"
+    )
     total_cmds = n_clients * workload.commands_per_client
     if max_seq is None:
         # worst case: every command coordinated by one process
@@ -70,9 +77,9 @@ def build_spec(
         zl = n_clients if zero_latency_clients is None else zero_latency_clients
         pool_slots = max(
             256,
-            2 * (config.n - 1) * workload.commands_per_client * max(zl, 1)
-            + 4 * n_clients * config.n
-            + 4 * config.n * config.n,
+            2 * (n_total - 1) * workload.commands_per_client * max(zl, 1)
+            + 4 * n_clients * n_total
+            + 4 * n_total * n_total,
         )
 
     proto_ms: List[int] = []
@@ -90,7 +97,8 @@ def build_spec(
     )
 
     return SimSpec(
-        n=config.n,
+        n=n_total,
+        shards=config.shard_count,
         n_clients=n_clients,
         n_client_groups=n_client_groups,
         key_space=workload.key_space(n_clients),
@@ -140,53 +148,81 @@ def build_env(
     seed: int = 0,
     make_distances_symmetric: bool = False,
 ) -> Env:
-    n = config.n
-    assert len(placement.process_regions) == n
+    n = config.n  # ranks per shard
+    shards = config.shard_count
+    N = n * shards  # total processes; g = shard * n + rank
+    assert len(placement.process_regions) == n, (
+        "placement lists one region per rank; every shard's rank r is placed"
+        " in the same region (the reference experiments colocate shards)"
+    )
+    assert N == spec.n
     C = len(placement.client_regions) * placement.clients_per_region
     assert C == spec.n_clients
 
-    pids = process_ids(0, n)  # 1-based reference ids
-    triples = [
-        (pid, 0, region) for pid, region in zip(pids, placement.process_regions)
+    # 1-based reference ids over all shards; process g = shard * n + rank
+    proc_region = [
+        placement.process_regions[g % n] for g in range(N)
     ]
-    id_to_idx = {pid: i for i, pid in enumerate(pids)}
+    triples = []
+    id_to_idx = {}
+    for s in range(shards):
+        for rank, pid in enumerate(process_ids(s, n)):
+            g = s * n + rank
+            triples.append((pid, s, proc_region[g]))
+            id_to_idx[pid] = g
 
-    # process-process one-way delays
+    # process-process one-way delays (region-based, shard-independent)
     dist_pp = planet.distance_matrix_ms(
-        placement.process_regions, placement.process_regions, make_distances_symmetric
+        proc_region, proc_region, make_distances_symmetric
     )
 
-    # per-process sorted order + quorum masks
+    # per-process sorted order + quorum masks (within the process's shard;
+    # BaseProcess::discover filters to same-shard processes for quorums)
     fq_size, wq_size, threshold = pdef.quorum_sizes(config)
     maj_size = config.majority_quorum_size()
-    sorted_procs = np.zeros((n, n), np.int32)
-    fq_mask = np.zeros((n,), np.int32)
-    wq_mask = np.zeros((n,), np.int32)
-    maj_mask = np.zeros((n,), np.int32)
-    for i, region in enumerate(placement.process_regions):
-        order = [id_to_idx[pid] for pid, _sid in
-                 sort_processes_by_distance(region, planet, triples)]
-        sorted_procs[i] = order
-        fq_mask[i] = mask_from_ids(order[:fq_size], n)
-        wq_mask[i] = mask_from_ids(order[:wq_size], n)
-        maj_mask[i] = mask_from_ids(order[:maj_size], n)
+    sorted_procs = np.zeros((N, N), np.int32)
+    fq_mask = np.zeros((N,), np.int32)
+    wq_mask = np.zeros((N,), np.int32)
+    maj_mask = np.zeros((N,), np.int32)
+    all_mask = np.zeros((N,), np.int32)
+    shard_of = np.zeros((N,), np.int32)
+    closest_shard_proc = np.zeros((N, shards), np.int32)
+    for g in range(N):
+        s = g // n
+        shard_of[g] = s
+        region = proc_region[g]
+        order_all = [id_to_idx[pid] for pid, _sid in
+                     sort_processes_by_distance(region, planet, triples)]
+        # pad the sorted list row (engine-facing metadata) with the global
+        # order; quorums below only use the same-shard prefix
+        sorted_procs[g] = order_all
+        same_shard = [i for i in order_all if i // n == s]
+        fq_mask[g] = mask_from_ids(same_shard[:fq_size], N)
+        wq_mask[g] = mask_from_ids(same_shard[:wq_size], N)
+        maj_mask[g] = mask_from_ids(same_shard[:maj_size], N)
+        all_mask[g] = mask_from_ids(same_shard, N)
+        closest = closest_process_per_shard(region, planet, triples)
+        for t in range(shards):
+            closest_shard_proc[g, t] = id_to_idx[closest[t]]
 
-    # clients: region-major ordering like the reference's registration loop
-    client_proc = np.zeros((C,), np.int32)
+    # clients: region-major ordering like the reference's registration loop;
+    # each client connects to the closest process of every shard
+    client_proc = np.zeros((C, shards), np.int32)
     client_group = np.zeros((C,), np.int32)
-    dist_cp = np.zeros((C,), np.int32)
-    dist_pc = np.zeros((n, C), np.int32)
+    dist_cp = np.zeros((C, shards), np.int32)
+    dist_pc = np.zeros((N, C), np.int32)
     c = 0
     for g, region in enumerate(placement.client_regions):
         closest = closest_process_per_shard(region, planet, triples)
-        p_idx = id_to_idx[closest[0]]
         for _ in range(placement.clients_per_region):
-            client_proc[c] = p_idx
+            for t in range(shards):
+                p_idx = id_to_idx[closest[t]]
+                client_proc[c, t] = p_idx
+                dist_cp[c, t] = planet.one_way_delay(
+                    region, proc_region[p_idx], make_distances_symmetric
+                )
             client_group[c] = g
-            dist_cp[c] = planet.one_way_delay(
-                region, placement.process_regions[p_idx], make_distances_symmetric
-            )
-            for i, pr in enumerate(placement.process_regions):
+            for i, pr in enumerate(proc_region):
                 dist_pc[i, c] = planet.one_way_delay(
                     pr, region, make_distances_symmetric
                 )
@@ -198,6 +234,8 @@ def build_env(
 
     kg = workload.key_gen
     return Env(
+        shard_of=np.asarray(shard_of),
+        closest_shard_proc=np.asarray(closest_shard_proc),
         dist_pp=np.asarray(dist_pp),
         dist_pc=np.asarray(dist_pc),
         dist_cp=np.asarray(dist_cp),
@@ -207,7 +245,7 @@ def build_env(
         fq_mask=np.asarray(fq_mask),
         wq_mask=np.asarray(wq_mask),
         maj_mask=np.asarray(maj_mask),
-        all_mask=np.int32((1 << n) - 1),
+        all_mask=np.asarray(all_mask),
         f=np.int32(config.f),
         fq_size=np.int32(fq_size),
         wq_size=np.int32(wq_size),

@@ -180,6 +180,10 @@ class ProtocolDef:
     quorum_sizes: Callable[[Any], Tuple[int, int, int]] = None
     # whether this protocol requires a leader (FPaxos)
     leaderless: bool = True
+    # the shard count this instance was built for (partial replication:
+    # cross-shard submit forwarding + shard-filtered execution); build_spec
+    # asserts it matches Config.shard_count
+    shards: int = 1
     # protocol-metric extraction from final state -> dict of arrays
     metrics: Optional[Callable[[Any], dict]] = None
 

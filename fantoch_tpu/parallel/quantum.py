@@ -161,6 +161,10 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
     )
     assert not spec.reorder, "message reordering is an event-engine mode"
     assert spec.batch_max_size <= 1, "batching needs open-loop clients"
+    assert spec.shards == 1, (
+        "the distributed runner is single-shard (shard-aware protocols land"
+        " with the partial-replication protocol machinery)"
+    )
     n, C_TOTAL, S = spec.n, spec.n_clients, spec.pool_slots
     W = max(message_width(pdef, spec.keys_per_command), 4 + spec.keys_per_command)
     KPC = spec.keys_per_command
@@ -189,7 +193,7 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
 
     def client_layout():
         """Pad clients into [n, CM] slots keyed by their coordinator."""
-        client_proc = np.asarray(env.client_proc)
+        client_proc = np.asarray(env.client_proc)[:, 0]
         cm = max(1, max(int((client_proc == p).sum()) for p in range(n)))
         present = np.zeros((n, cm), bool)
         gcid = np.zeros((n, cm), np.int32)
@@ -206,7 +210,7 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             present[p, s] = True
             gcid[p, s] = c
             group[p, s] = int(np.asarray(env.client_group)[c])
-            dcp[p, s] = int(np.asarray(env.dist_cp)[c])
+            dcp[p, s] = int(np.asarray(env.dist_cp)[c, 0])
             dpc[p, s] = int(np.asarray(env.dist_pc)[p, c])
             g2p[c] = p
             g2s[c] = s
@@ -319,14 +323,16 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         return Env(
             dist_pp=lenv.dist_pp[myrow][None, :],
             dist_pc=lenv.cl_dist_pc[myrow][None, :],
-            dist_cp=lenv.cl_dist_cp[myrow],
-            client_proc=jnp.zeros((CM,), jnp.int32),
+            dist_cp=lenv.cl_dist_cp[myrow][:, None],
+            client_proc=jnp.zeros((CM, 1), jnp.int32),
+            shard_of=jnp.zeros((1,), jnp.int32),
+            closest_shard_proc=jnp.zeros((1, 1), jnp.int32),
             client_group=lenv.cl_group[myrow],
             sorted_procs=lenv.sorted_procs[myrow][None, :],
             fq_mask=lenv.fq_mask[myrow][None],
             wq_mask=lenv.wq_mask[myrow][None],
             maj_mask=lenv.maj_mask[myrow][None],
-            all_mask=lenv.all_mask,
+            all_mask=lenv.all_mask[myrow][None],
             f=lenv.f,
             fq_size=lenv.fq_size,
             wq_size=lenv.wq_size,
@@ -512,7 +518,7 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
                  ro.astype(jnp.int32)]
                 + [keys[k] for k in range(KPC)]
             )
-            others = lenv.all_mask & ~(jnp.int32(1) << myrow)
+            others = lenv.all_mask[myrow] & ~(jnp.int32(1) << myrow)
             L = send_broadcast(L, myrow, others, jnp.int32(RK_CMD), cmd_payload, ok)
             ctx = _ctx(L.st, local_env_view(myrow), myrow)
             pst, outbox, execout = pdef.submit(

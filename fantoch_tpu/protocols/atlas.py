@@ -148,7 +148,7 @@ def _make(variant: str, n: int, keys_per_command: int, nfr: bool) -> ProtocolDef
         )
         ob = outbox_row(
             empty_outbox(MAX_OUT, MSG_W), 0,
-            jnp.bool_(True), ctx.env.all_mask, MCOLLECT,
+            jnp.bool_(True), ctx.env.all_mask[p], MCOLLECT,
             [dot, ctx.env.fq_mask[p]] + list(deps),
         )
         return st, ob, empty_execout(MAX_EXEC, EW)
@@ -227,7 +227,7 @@ def _make(variant: str, n: int, keys_per_command: int, nfr: bool) -> ProtocolDef
             slow_count=st.slow_count.at[p].add(slow.astype(jnp.int32)),
         )
         row_kind = jnp.where(fast, MCOMMIT, MCONSENSUS)
-        row_tgt = jnp.where(fast, ctx.env.all_mask, ctx.env.wq_mask[p])
+        row_tgt = jnp.where(fast, ctx.env.all_mask[p], ctx.env.wq_mask[p])
         commit_payload = jnp.concatenate([dot[None], union]).astype(jnp.int32)
         cons_payload = jnp.concatenate(
             [dot[None], (ctx.pid + 1)[None], union]
@@ -295,7 +295,7 @@ def _make(variant: str, n: int, keys_per_command: int, nfr: bool) -> ProtocolDef
         st = st._replace(synod=sy)
         ob = outbox_row(
             empty_outbox(MAX_OUT, MSG_W), 0,
-            chosen, ctx.env.all_mask, MCOMMIT,
+            chosen, ctx.env.all_mask[p], MCOMMIT,
             [dot] + list(st.prop_deps[p, dot]),
         )
         return st, ob, empty_execout(MAX_EXEC, EW)
@@ -321,7 +321,7 @@ def _make(variant: str, n: int, keys_per_command: int, nfr: bool) -> ProtocolDef
         return jax.lax.switch(kind, branches, st, p, src, payload, now)
 
     def periodic(ctx, st: AtlasState, p, kind, now):
-        all_but_me = ctx.env.all_mask & ~(jnp.int32(1) << ctx.pid)
+        all_but_me = ctx.env.all_mask[p] & ~(jnp.int32(1) << ctx.pid)
         row = gc_mod.gc_frontier_row(st.gc, p)
         ob = outbox_row(
             empty_outbox(1, MSG_W), 0,

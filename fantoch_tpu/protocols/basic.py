@@ -45,7 +45,8 @@ MSTORE = 0
 MSTOREACK = 1
 MCOMMIT = 2
 MGC = 3
-N_KINDS = 4
+MFORWARD = 4  # cross-shard submit forward (partial.rs submit_actions)
+N_KINDS = 5
 
 
 class BasicState(NamedTuple):
@@ -56,10 +57,18 @@ class BasicState(NamedTuple):
     commit_count: jnp.ndarray  # [n] int32 commits handled
 
 
-def make_protocol(n: int, keys_per_command: int = 1) -> ProtocolDef:
+def make_protocol(n: int, keys_per_command: int = 1, shards: int = 1) -> ProtocolDef:
+    """`n` is the TOTAL process count (ranks x shards); with `shards` > 1
+    a multi-shard command is forwarded to the closest process of every other
+    shard it touches (`fantoch_ps/src/protocol/partial.rs:8-35`
+    submit_actions), each shard runs its own f+1-ack round, and every
+    replica executes only its own shard's keys (`basic.rs:264`
+    `cmd.iter(self.bp.shard_id)`)."""
     KPC = keys_per_command
     MSG_W = max(2, n)
-    MAX_OUT = 2
+    # submit row 0 = MStore; rows 1..shards = one (statically allocated)
+    # forward row per shard, inert for the submitter's own shard
+    MAX_OUT = 2 if shards == 1 else 1 + shards
     MAX_EXEC = KPC
     exdef = basic_executor.make_executor(n)
     EW = exdef.exec_width
@@ -78,16 +87,44 @@ def make_protocol(n: int, keys_per_command: int = 1) -> ProtocolDef:
         """Single-entry outbox helper."""
         return outbox_row(empty_outbox(MAX_OUT, MSG_W), 0, valid, tgt_mask, kind, payload_vals)
 
+    def _shard_slot_mask(ctx, dot):
+        """[KPC] bool: key slots owned by the handling process's shard."""
+        if shards == 1:
+            return jnp.ones((KPC,), jnp.bool_)
+        myshard = ctx.env.shard_of[ctx.pid]
+        return (ctx.cmds.keys[dot] % shards) == myshard
+
     def submit(ctx, st: BasicState, p, dot, now):
-        # MStore to all, fast quorum attached (basic.rs:170-186)
-        ob = _outbox1(jnp.bool_(True), ctx.env.all_mask, MSTORE, [dot, ctx.env.fq_mask[p]])
+        # MStore to all shard members, fast quorum attached (basic.rs:170-186)
+        ob = _outbox1(jnp.bool_(True), ctx.env.all_mask[p], MSTORE, [dot, ctx.env.fq_mask[p]])
+        # forward the submit to every other shard the command touches
+        # (partial.rs submit_actions; only the target-shard coordinator,
+        # i.e. the submit recipient, ever does this)
+        if shards > 1:
+            myshard = ctx.env.shard_of[ctx.pid]
+            key_shards = ctx.cmds.keys[dot] % shards
+            for t in range(shards):
+                touches = (key_shards == t).any()
+                en = touches & (jnp.int32(t) != myshard)
+                tgt = jnp.int32(1) << ctx.env.closest_shard_proc[p, t]
+                ob = outbox_row(ob, 1 + t, en, tgt, MFORWARD, [dot])
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def h_mforward(ctx, st: BasicState, p, src, payload, now):
+        # run the agreement for this shard's part of the command: the dot is
+        # the original coordinator's (partial.rs keeps one dot per command)
+        dot = payload[0]
+        ob = _outbox1(
+            jnp.bool_(True), ctx.env.all_mask[p], MSTORE,
+            [dot, ctx.env.fq_mask[p]],
+        )
         return st, ob, empty_execout(MAX_EXEC, EW)
 
     def _commit(ctx, st: BasicState, p, dot, enable):
         """Commit path (basic.rs:251-282): emit per-key execution infos and
         record the dot as committed (inlines the self-forwarded MCommitDot)."""
         execout = ExecOut(
-            valid=jnp.broadcast_to(enable, (MAX_EXEC,)),
+            valid=jnp.broadcast_to(enable, (MAX_EXEC,)) & _shard_slot_mask(ctx, dot),
             info=jnp.stack(
                 [
                     jnp.stack(
@@ -123,7 +160,7 @@ def make_protocol(n: int, keys_per_command: int = 1) -> ProtocolDef:
         acks = st.acks[p, dot] + 1
         st = st._replace(acks=st.acks.at[p, dot].set(acks))
         # all replies in: commit (basic.rs:237-248)
-        ob = _outbox1(acks == ctx.env.fq_size, ctx.env.all_mask, MCOMMIT, [dot])
+        ob = _outbox1(acks == ctx.env.fq_size, ctx.env.all_mask[p], MCOMMIT, [dot])
         return st, ob, empty_execout(MAX_EXEC, EW)
 
     def h_mcommit(ctx, st: BasicState, p, src, payload, now):
@@ -139,20 +176,23 @@ def make_protocol(n: int, keys_per_command: int = 1) -> ProtocolDef:
 
     def h_mgc(ctx, st: BasicState, p, src, payload, now):
         st = st._replace(
-            gc=gc_mod.gc_handle_mgc(st.gc, p, src, payload[:n], pid=ctx.pid)
+            gc=gc_mod.gc_handle_mgc(
+                st.gc, p, src, payload[:n], pid=ctx.pid,
+                peers_mask=ctx.env.all_mask[p],
+            )
         )
         return st, empty_outbox(MAX_OUT, MSG_W), empty_execout(MAX_EXEC, EW)
 
     def handle(ctx, st, p, src, kind, payload, now):
         branches = [
             functools.partial(h, ctx)
-            for h in (h_mstore, h_mstoreack, h_mcommit, h_mgc)
+            for h in (h_mstore, h_mstoreack, h_mcommit, h_mgc, h_mforward)
         ]
         return jax.lax.switch(kind, branches, st, p, src, payload, now)
 
     def periodic(ctx, st: BasicState, p, kind, now):
         # GarbageCollection: broadcast own committed clock (basic.rs:320-331)
-        all_but_me = ctx.env.all_mask & ~(jnp.int32(1) << ctx.pid)
+        all_but_me = ctx.env.all_mask[p] & ~(jnp.int32(1) << ctx.pid)
         row = gc_mod.gc_frontier_row(st.gc, p)
         ob = _outbox1(jnp.bool_(True), all_but_me, MGC, [row[a] for a in range(n)])
         return st, ob
@@ -177,5 +217,6 @@ def make_protocol(n: int, keys_per_command: int = 1) -> ProtocolDef:
         periodic=periodic,
         quorum_sizes=lambda cfg: (cfg.basic_quorum_size(), 0, 0),
         leaderless=True,
+        shards=shards,
         metrics=metrics,
     )

@@ -219,7 +219,7 @@ def make_protocol(
         st, clock = _clock_next(st, p, ctx.pid, True)
         ob = outbox_row(
             empty_outbox(MAX_OUT, MSG_W), 0,
-            jnp.bool_(True), ctx.env.all_mask, MPROPOSE, [dot, clock],
+            jnp.bool_(True), ctx.env.all_mask[p], MPROPOSE, [dot, clock],
         )
         return st, ob, empty_execout(MAX_EXEC, EW)
 
@@ -341,7 +341,7 @@ def make_protocol(
         )
         ob = outbox_row(
             empty_outbox(MAX_OUT, MSG_W), 0,
-            all_in, ctx.env.all_mask,
+            all_in, ctx.env.all_mask[p],
             jnp.where(fast, MCOMMIT, MRETRY),
             [dot, st.qc_clock[p, dot], ctx.pid] + list(st.qc_deps[p, dot]),
         )
@@ -455,7 +455,7 @@ def make_protocol(
         )
         ob = outbox_row(
             empty_outbox(MAX_OUT, MSG_W), 0,
-            all_in, ctx.env.all_mask, MCOMMIT,
+            all_in, ctx.env.all_mask[p], MCOMMIT,
             [dot, st.clock_of[p, dot], ctx.pid] + list(st.qr_deps[p, dot]),
         )
         return st, ob, empty_execout(MAX_EXEC, EW)
@@ -561,7 +561,7 @@ def make_protocol(
         return st, empty_outbox(MAX_OUT, MSG_W)
 
     def periodic(ctx, st: CaesarState, p, kind, now):
-        all_but_me = ctx.env.all_mask & ~(jnp.int32(1) << ctx.pid)
+        all_but_me = ctx.env.all_mask[p] & ~(jnp.int32(1) << ctx.pid)
         ob = outbox_row(
             empty_outbox(1, MSG_W), 0,
             jnp.bool_(True), all_but_me, MGC, list(st.gcexec[p, ctx.pid]),

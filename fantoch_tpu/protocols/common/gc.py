@@ -63,18 +63,25 @@ def gc_commit(gc: GCTrack, p, dot, enable, max_seq: int) -> GCTrack:
     )
 
 
-def gc_handle_mgc(gc: GCTrack, p, src, incoming: jnp.ndarray, pid=None) -> GCTrack:
+def gc_handle_mgc(gc: GCTrack, p, src, incoming: jnp.ndarray, pid=None,
+                  peers_mask=None) -> GCTrack:
     """Join a peer's committed clock and fold newly-stable dots into the
     Stable metric (inlines the `MStable` self-forward).
 
     `pid` is the process's global identity (ctx.pid); `p` only indexes the
-    state row (they differ under the distributed runner)."""
+    state row (they differ under the distributed runner). `peers_mask` is a
+    bitmask of the processes whose reports stability waits on (the GC
+    group — the process's shard under partial replication); defaults to
+    every process."""
     n = gc.clock_of.shape[1]
     gc = gc._replace(
         clock_of=gc.clock_of.at[p, src].set(jnp.maximum(gc.clock_of[p, src], incoming)),
         heard_from=gc.heard_from.at[p, src].set(True),
     )
-    others = jnp.arange(n) != (p if pid is None else pid)
+    me = p if pid is None else pid
+    others = jnp.arange(n) != me
+    if peers_mask is not None:
+        others = others & (((peers_mask >> jnp.arange(n)) & 1) == 1)
     all_heard = jnp.where(others, gc.heard_from[p], True).all()
     peer_min = jnp.where(others[:, None], gc.clock_of[p], jnp.int32(2**30)).min(axis=0)
     stable = jnp.minimum(gc.frontier[p], peer_min)

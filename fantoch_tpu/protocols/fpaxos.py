@@ -175,7 +175,7 @@ def make_protocol(n: int, keys_per_command: int = 1) -> ProtocolDef:
             cmdr_alive=st.cmdr_alive.at[p, idx].set(st.cmdr_alive[p, idx] & ~chosen),
         )
         ob = outbox_row(
-            empty_outbox(MAX_OUT, MSG_W), 0, chosen, ctx.env.all_mask, MCHOSEN,
+            empty_outbox(MAX_OUT, MSG_W), 0, chosen, ctx.env.all_mask[p], MCHOSEN,
             [slot, st.cmdr_dot[p, idx]],
         )
         return st, ob, empty_execout(MAX_EXEC, EW)
@@ -233,7 +233,7 @@ def make_protocol(n: int, keys_per_command: int = 1) -> ProtocolDef:
 
     def periodic(ctx, st: FPaxosState, p, kind, now):
         # GarbageCollection: broadcast own committed frontier (fpaxos.rs:363-378)
-        all_but_me = ctx.env.all_mask & ~(jnp.int32(1) << ctx.pid)
+        all_but_me = ctx.env.all_mask[p] & ~(jnp.int32(1) << ctx.pid)
         ob = outbox_row(
             empty_outbox(MAX_OUT, MSG_W), 0, jnp.bool_(True), all_but_me, MGC,
             [st.frontier[p]],

@@ -189,7 +189,7 @@ def make_protocol(
         st, ss, es = _vote_up_to(st, p, keys, up_to, enable)
         for i in range(KPC):
             ob = outbox_row(
-                ob, row0 + i, ss[i] > 0, ctx.env.all_mask, MDETACHED,
+                ob, row0 + i, ss[i] > 0, ctx.env.all_mask[p], MDETACHED,
                 [keys[i], ss[i], es[i]],
             )
         return st, ob
@@ -263,7 +263,7 @@ def make_protocol(
             qmask = ctx.env.fq_mask[p]
         ob = outbox_row(
             empty_outbox(MAX_OUT, MSG_W), 0,
-            jnp.bool_(True), ctx.env.all_mask, MCOLLECT, [dot, clock, qmask],
+            jnp.bool_(True), ctx.env.all_mask[p], MCOLLECT, [dot, clock, qmask],
         )
         return st, ob, empty_execout(MAX_EXEC, EW)
 
@@ -369,7 +369,7 @@ def make_protocol(
             slow_count=st.slow_count.at[p].add(slow.astype(jnp.int32)),
         )
         row_kind = jnp.where(fast, MCOMMIT, MCONSENSUS)
-        row_tgt = jnp.where(fast, ctx.env.all_mask, ctx.env.wq_mask[p])
+        row_tgt = jnp.where(fast, ctx.env.all_mask[p], ctx.env.wq_mask[p])
         cons_payload = [dot, ctx.pid + 1, new_max]
         width = max(len(commit_payload), len(cons_payload))
         pay = jnp.where(
@@ -465,7 +465,7 @@ def make_protocol(
         commit_payload = _mcommit_payload(st.votes_s, st.votes_e, p, dot, value)
         ob = outbox_row(
             empty_outbox(MAX_OUT, MSG_W), 0,
-            chosen, ctx.env.all_mask, MCOMMIT, commit_payload,
+            chosen, ctx.env.all_mask[p], MCOMMIT, commit_payload,
         )
         return st, ob, empty_execout(MAX_EXEC, EW)
 
@@ -497,7 +497,7 @@ def make_protocol(
     def periodic(ctx, st: TempoState, p, kind, now):
         if kind == 0:
             # GarbageCollection (tempo.rs:973-988)
-            all_but_me = ctx.env.all_mask & ~(jnp.int32(1) << ctx.pid)
+            all_but_me = ctx.env.all_mask[p] & ~(jnp.int32(1) << ctx.pid)
             row = gc_mod.gc_frontier_row(st.gc, p)
             ob = outbox_row(
                 empty_outbox(1, MSG_W), 0,
@@ -515,7 +515,7 @@ def make_protocol(
             old = clocks[p, k]
             votes = old < up_to
             ob = outbox_row(
-                ob, k, votes, ctx.env.all_mask, MDETACHED, [jnp.int32(k), old + 1, up_to]
+                ob, k, votes, ctx.env.all_mask[p], MDETACHED, [jnp.int32(k), old + 1, up_to]
             )
             clocks = clocks.at[p, k].set(jnp.maximum(old, up_to))
         return st._replace(clocks=clocks), ob

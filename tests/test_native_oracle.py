@@ -66,8 +66,8 @@ def run_both(n, f, process_regions, client_regions, clients_per_region, cmds):
         max_steps=spec.max_steps,
         dist_pp=env.dist_pp,
         dist_pc=env.dist_pc,
-        dist_cp=env.dist_cp,
-        client_proc=env.client_proc,
+        dist_cp=env.dist_cp[:, 0],
+        client_proc=env.client_proc[:, 0],
         fq_mask=env.fq_mask,
     )
     return engine, oracle
