@@ -143,8 +143,13 @@ def make_executor(n: int) -> ExecutorDef:
         KPC = ctx.spec.keys_per_command
         DOTS = est.tbl_clock.shape[1]
         threshold = ctx.env.threshold
-        # stable clock = threshold-th largest per-voter frontier
-        frontiers = jnp.sort(est.vt_frontier[p, key])  # ascending [n]
+        # stable clock = threshold-th largest frontier among the voters of
+        # this process's shard (non-members mask to -1 so they sort below
+        # every real frontier; single-shard: every process is a member)
+        member = ((ctx.env.all_mask[p] >> jnp.arange(n)) & 1) == 1
+        frontiers = jnp.sort(
+            jnp.where(member, est.vt_frontier[p, key], -1)
+        )  # ascending [n]
         stable_clock = frontiers[n - threshold]
 
         dots = jnp.arange(DOTS, dtype=jnp.int32)

@@ -40,6 +40,7 @@ from ..engine.types import (
 )
 from ..executors import basic as basic_executor
 from .common import gc as gc_mod
+from .common import sharding
 
 MSTORE = 0
 MSTOREACK = 1
@@ -88,11 +89,7 @@ def make_protocol(n: int, keys_per_command: int = 1, shards: int = 1) -> Protoco
         return outbox_row(empty_outbox(MAX_OUT, MSG_W), 0, valid, tgt_mask, kind, payload_vals)
 
     def _shard_slot_mask(ctx, dot):
-        """[KPC] bool: key slots owned by the handling process's shard."""
-        if shards == 1:
-            return jnp.ones((KPC,), jnp.bool_)
-        myshard = ctx.env.shard_of[ctx.pid]
-        return (ctx.cmds.keys[dot] % shards) == myshard
+        return sharding.slot_mask(ctx, dot, shards)
 
     def submit(ctx, st: BasicState, p, dot, now):
         # MStore to all shard members, fast quorum attached (basic.rs:170-186)
@@ -102,10 +99,9 @@ def make_protocol(n: int, keys_per_command: int = 1, shards: int = 1) -> Protoco
         # i.e. the submit recipient, ever does this)
         if shards > 1:
             myshard = ctx.env.shard_of[ctx.pid]
-            key_shards = ctx.cmds.keys[dot] % shards
+            touch = sharding.shard_touch(ctx, dot, shards)
             for t in range(shards):
-                touches = (key_shards == t).any()
-                en = touches & (jnp.int32(t) != myshard)
+                en = touch[t] & (jnp.int32(t) != myshard)
                 tgt = jnp.int32(1) << ctx.env.closest_shard_proc[p, t]
                 ob = outbox_row(ob, 1 + t, en, tgt, MFORWARD, [dot])
         return st, ob, empty_execout(MAX_EXEC, EW)

@@ -1,0 +1,32 @@
+"""Shared shard-routing helpers (partial replication).
+
+One home for the key→shard convention (`key % shards`, mirroring the
+reference's `key_hash(key) % shard_count`, `fantoch/src/client/
+workload.rs:208-211`) so protocols and the engine cannot drift: the engine
+routes submits by the first key's shard (engine/lockstep.py), and protocols
+use these helpers for per-slot execution masks and cross-shard forwarding.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def key_shard(key, shards: int):
+    """Shard owning `key` (traceable)."""
+    return key % shards
+
+
+def slot_mask(ctx, dot, shards: int):
+    """[KPC] bool: key slots owned by the handling process's shard
+    (`cmd.keys(self.bp.shard_id)` — a process only clocks/votes/executes
+    its own shard's keys)."""
+    kpc = ctx.cmds.keys.shape[1]
+    if shards == 1:
+        return jnp.ones((kpc,), jnp.bool_)
+    return key_shard(ctx.cmds.keys[dot], shards) == ctx.env.shard_of[ctx.pid]
+
+
+def shard_touch(ctx, dot, shards: int):
+    """[shards] bool: shards the command has a key in."""
+    ks = key_shard(ctx.cmds.keys[dot], shards)
+    return jnp.stack([(ks == t).any() for t in range(shards)])

@@ -51,6 +51,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..core.ids import dot_proc
 from ..engine.types import (
     ExecOut,
     ProtocolDef,
@@ -61,6 +62,7 @@ from ..engine.types import (
 )
 from ..executors import table as table_executor
 from .common import gc as gc_mod
+from .common import sharding
 from .common import synod as synod_mod
 
 MCOLLECT = 0
@@ -70,7 +72,11 @@ MDETACHED = 3
 MCONSENSUS = 4
 MCONSENSUSACK = 5
 MGC = 6
-N_KINDS = 7
+# partial replication (tempo.rs partial bits via protocol/partial.rs)
+MFWD = 7  # MForwardSubmit: run the agreement for your shard's part
+MSHARDC = 8  # MShardCommit: shard-local final clock -> aggregator
+MSHARDAGG = 9  # MShardAggregatedCommit: max clock -> shard coordinators
+N_KINDS = 10
 
 # status (tempo.rs Status)
 START = 0
@@ -97,6 +103,9 @@ class TempoState(NamedTuple):
     bufc_s: jnp.ndarray  # [n, DOTS, KPC, n] int32
     bufc_e: jnp.ndarray  # [n, DOTS, KPC, n] int32
     synod: synod_mod.SynodState
+    # multi-shard commit aggregation at the dot's coordinator (ShardsCommits)
+    sc_cnt: jnp.ndarray  # [n, DOTS] int32 shard clocks received
+    sc_max: jnp.ndarray  # [n, DOTS] int32 max shard clock
     max_commit_clock: jnp.ndarray  # [n] int32
     gc: gc_mod.GCTrack
     fast_count: jnp.ndarray  # [n] int32
@@ -110,15 +119,25 @@ def make_protocol(
     key_space_hint: int = 0,
     nfr: bool = False,
     clock_bump: bool = False,
+    shards: int = 1,
 ) -> ProtocolDef:
     """Build the Tempo ProtocolDef.
 
     `key_space_hint` is only needed when `clock_bump` is set (the ClockBump
     periodic event iterates all keys, so its outbox is K rows wide).
+    With `shards` > 1, `n` is the TOTAL process count and multi-shard
+    commands follow the reference's partial-replication flow
+    (`protocol/partial.rs` + the tempo.rs MShardCommit handlers): the
+    target-shard coordinator forwards the submit to the closest process of
+    every other shard touched, each shard agrees on a shard-local clock for
+    its own keys, shard clocks are aggregated at the dot's coordinator, and
+    the max becomes every shard's commit timestamp.
     """
     KPC = keys_per_command
+    ranks = n // shards  # replicas per shard
+    assert ranks * shards == n
     MSG_W = max(2 + 2 * KPC * n, n, 3)
-    MAX_OUT = 1 + KPC
+    MAX_OUT = max(2 + KPC + (1 if shards > 1 else 0), 1 + shards)
     MAX_EXEC = KPC
     exdef = table_executor.make_executor(n)
     EW = exdef.exec_width
@@ -142,6 +161,8 @@ def make_protocol(
             bufc_s=z(n, DOTS, KPC, n),
             bufc_e=z(n, DOTS, KPC, n),
             synod=synod_mod.synod_init(n, DOTS),
+            sc_cnt=z(n, DOTS),
+            sc_max=z(n, DOTS),
             max_commit_clock=z(n),
             gc=gc_mod.gc_init(n, DOTS),
             fast_count=z(n),
@@ -153,7 +174,13 @@ def make_protocol(
     # clock bumping / vote generation (common/table/clocks/keys)
     # ------------------------------------------------------------------
 
-    def _vote_up_to(st: TempoState, p, keys, up_to, enable):
+    def _slot_mask(ctx, dot):
+        return sharding.slot_mask(ctx, dot, shards)
+
+    def _shard_touch(ctx, dot):
+        return sharding.shard_touch(ctx, dot, shards)
+
+    def _vote_up_to(st: TempoState, p, keys, up_to, enable, slot_en=None):
         """Bump each key's clock to `up_to`, returning one vote range per key
         slot (`sequential.rs:100-118` maybe_bump). Sequential over slots so
         duplicate keys within a command vote once."""
@@ -163,6 +190,8 @@ def make_protocol(
             k = keys[i]
             old = clocks[p, k]
             votes = enable & (old < up_to)
+            if slot_en is not None:
+                votes = votes & slot_en[i]
             ss.append(jnp.where(votes, old + 1, 0))
             es.append(jnp.where(votes, up_to, 0))
             clocks = clocks.at[p, k].set(jnp.where(votes, up_to, old))
@@ -170,23 +199,26 @@ def make_protocol(
 
     def _proposal(ctx, st: TempoState, p, dot, min_clock, enable):
         """KeyClocks::proposal — clock = max(min_clock, cur+1) (no bump for
-        NFR-allowed reads), votes = the bumped ranges per key."""
+        NFR-allowed reads), votes = the bumped ranges per key. Only the
+        handling process's own shard's key slots participate."""
         keys = ctx.cmds.keys[dot]
+        mask = _slot_mask(ctx, dot)
         cur = jnp.int32(0)
         for i in range(KPC):
-            cur = jnp.maximum(cur, st.clocks[p, keys[i]])
+            cur = jnp.maximum(cur, jnp.where(mask[i], st.clocks[p, keys[i]], 0))
         bump = jnp.int32(1)
         if nfr and KPC == 1:
             bump = jnp.where(ctx.cmds.read_only[dot], 0, 1)
         clock = jnp.maximum(min_clock, cur + bump)
-        st, ss, es = _vote_up_to(st, p, keys, clock, enable)
+        st, ss, es = _vote_up_to(st, p, keys, clock, enable, slot_en=mask)
         return st, clock, ss, es
 
     def _detached_rows(ctx, st: TempoState, ob, row0, p, dot, up_to, enable):
         """Generate detached votes on the dot's keys up to `up_to` and emit
         them eagerly as MDETACHED broadcast rows (see module docstring)."""
         keys = ctx.cmds.keys[dot]
-        st, ss, es = _vote_up_to(st, p, keys, up_to, enable)
+        st, ss, es = _vote_up_to(st, p, keys, up_to, enable,
+                                 slot_en=_slot_mask(ctx, dot))
         for i in range(KPC):
             ob = outbox_row(
                 ob, row0 + i, ss[i] > 0, ctx.env.all_mask[p], MDETACHED,
@@ -233,7 +265,7 @@ def make_protocol(
                 row += [rs[k, v], re[k, v]]
             info_rows.append(jnp.stack([jnp.asarray(x, jnp.int32) for x in row]))
         execout = ExecOut(
-            valid=jnp.broadcast_to(enable, (MAX_EXEC,)),
+            valid=jnp.broadcast_to(enable, (MAX_EXEC,)) & _slot_mask(ctx, dot),
             info=jnp.stack(info_rows),
         )
         # detached votes up to the commit clock (tempo.rs:645-656); with
@@ -241,6 +273,27 @@ def make_protocol(
         if not clock_bump:
             st, ob = _detached_rows(ctx, st, ob, row0, p, dot, clock, enable)
         return st, ob, execout
+
+    def _commit_or_aggregate(ctx, st, ob, rowA, rowB, p, dot, clock, enable):
+        """Single-shard commands broadcast `MCommit` in-shard; multi-shard
+        commands send `MShardCommit{dot, clock}` to the dot's coordinator
+        for aggregation (partial.rs mcommit_actions)."""
+        if shards == 1:
+            pay = _mcommit_payload(st.votes_s, st.votes_e, p, dot, clock)
+            ob = outbox_row(ob, rowA, enable, ctx.env.all_mask[p], MCOMMIT, pay)
+            return st, ob
+        nsh = _shard_touch(ctx, dot).sum()
+        single = nsh <= 1
+        pay = _mcommit_payload(st.votes_s, st.votes_e, p, dot, clock)
+        ob = outbox_row(
+            ob, rowA, enable & single, ctx.env.all_mask[p], MCOMMIT, pay
+        )
+        agg = dot_proc(dot, ctx.spec.max_seq)
+        ob = outbox_row(
+            ob, rowB, enable & ~single, jnp.int32(1) << agg, MSHARDC,
+            [dot, clock],
+        )
+        return st, ob
 
     # ------------------------------------------------------------------
     # handlers
@@ -264,6 +317,68 @@ def make_protocol(
         ob = outbox_row(
             empty_outbox(MAX_OUT, MSG_W), 0,
             jnp.bool_(True), ctx.env.all_mask[p], MCOLLECT, [dot, clock, qmask],
+        )
+        # forward the submit to every other shard the command touches
+        # (partial.rs submit_actions)
+        if shards > 1:
+            myshard = ctx.env.shard_of[ctx.pid]
+            touch = _shard_touch(ctx, dot)
+            for t in range(shards):
+                en = touch[t] & (jnp.int32(t) != myshard)
+                tgt = jnp.int32(1) << ctx.env.closest_shard_proc[p, t]
+                ob = outbox_row(ob, 1 + t, en, tgt, MFWD, [dot])
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def h_mfwd(ctx, st: TempoState, p, src, payload, now):
+        """MForwardSubmit at this shard's designated coordinator: make the
+        shard-local proposal and start this shard's collect round."""
+        dot = payload[0]
+        st, clock, ss, es = _proposal(ctx, st, p, dot, jnp.int32(0), jnp.bool_(True))
+        st = st._replace(
+            votes_s=st.votes_s.at[p, dot, :, ctx.pid].set(ss),
+            votes_e=st.votes_e.at[p, dot, :, ctx.pid].set(es),
+        )
+        ob = outbox_row(
+            empty_outbox(MAX_OUT, MSG_W), 0,
+            jnp.bool_(True), ctx.env.all_mask[p], MCOLLECT,
+            [dot, clock, ctx.env.fq_mask[p]],
+        )
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def h_mshardc(ctx, st: TempoState, p, src, payload, now):
+        """MShardCommit at the aggregator (the dot's coordinator): max the
+        shard clocks; once every touched shard reported, send the aggregated
+        clock back to each shard's coordinator (partial.rs
+        handle_mshard_commit)."""
+        dot, clock = payload[0], payload[1]
+        cnt = st.sc_cnt[p, dot] + 1
+        mx = jnp.maximum(st.sc_max[p, dot], clock)
+        st = st._replace(
+            sc_cnt=st.sc_cnt.at[p, dot].set(cnt),
+            sc_max=st.sc_max.at[p, dot].set(mx),
+        )
+        touch = _shard_touch(ctx, dot)
+        done = cnt == touch.sum()
+        # participants: the per-shard coordinators this dot's submit chose
+        tgt = jnp.int32(0)
+        for t in range(shards):
+            tgt = tgt | jnp.where(
+                touch[t], jnp.int32(1) << ctx.env.closest_shard_proc[p, t], 0
+            )
+        ob = outbox_row(
+            empty_outbox(MAX_OUT, MSG_W), 0, done, tgt, MSHARDAGG, [dot, mx]
+        )
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def h_mshardagg(ctx, st: TempoState, p, src, payload, now):
+        """MShardAggregatedCommit at a shard coordinator: broadcast the
+        final MCommit in this shard with the aggregated clock and this
+        shard's votes (partial.rs handle_mshard_aggregated_commit)."""
+        dot, clock = payload[0], payload[1]
+        pay = _mcommit_payload(st.votes_s, st.votes_e, p, dot, clock)
+        ob = outbox_row(
+            empty_outbox(MAX_OUT, MSG_W), 0,
+            jnp.bool_(True), ctx.env.all_mask[p], MCOMMIT, pay,
         )
         return st, ob, empty_execout(MAX_EXEC, EW)
 
@@ -353,13 +468,11 @@ def make_protocol(
 
         # all fast-quorum clocks in? (tempo.rs:524-570)
         all_in = collect & (count == st.qsize[p, dot])
-        minority = n // 2
+        minority = ranks // 2  # a minority of this shard's replicas
         threshold = st.qsize[p, dot] - minority
         fast = all_in & (new_cnt >= threshold)
         slow = all_in & ~(new_cnt >= threshold)
 
-        # fast path: MCommit with the aggregated votes
-        commit_payload = _mcommit_payload(votes_s, votes_e, p, dot, new_max)
         # slow path: synod with skipped prepare (ballot = 1-based own id)
         st = st._replace(
             synod=synod_mod.skip_prepare(
@@ -368,16 +481,14 @@ def make_protocol(
             fast_count=st.fast_count.at[p].add(fast.astype(jnp.int32)),
             slow_count=st.slow_count.at[p].add(slow.astype(jnp.int32)),
         )
-        row_kind = jnp.where(fast, MCOMMIT, MCONSENSUS)
-        row_tgt = jnp.where(fast, ctx.env.all_mask[p], ctx.env.wq_mask[p])
-        cons_payload = [dot, ctx.pid + 1, new_max]
-        width = max(len(commit_payload), len(cons_payload))
-        pay = jnp.where(
-            fast,
-            jnp.stack([jnp.asarray(x, jnp.int32) for x in commit_payload + [jnp.int32(0)] * (width - len(commit_payload))]),
-            jnp.stack([jnp.asarray(x, jnp.int32) for x in cons_payload + [jnp.int32(0)] * (width - len(cons_payload))]),
+        ob = outbox_row(
+            ob, 0, slow, ctx.env.wq_mask[p], MCONSENSUS,
+            [dot, ctx.pid + 1, new_max],
         )
-        ob = outbox_row(ob, 0, all_in, row_tgt, row_kind, list(pay))
+        # fast path: MCommit in-shard, or MShardCommit to the aggregator
+        st, ob = _commit_or_aggregate(
+            ctx, st, ob, 1 + KPC, 2 + KPC, p, dot, new_max, fast
+        )
         return st, ob, empty_execout(MAX_EXEC, EW)
 
     def h_mcommit(ctx, st: TempoState, p, src, payload, now):
@@ -462,16 +573,17 @@ def make_protocol(
         )
         chosen = chosen & not_committed
         st = st._replace(synod=sy)
-        commit_payload = _mcommit_payload(st.votes_s, st.votes_e, p, dot, value)
-        ob = outbox_row(
-            empty_outbox(MAX_OUT, MSG_W), 0,
-            chosen, ctx.env.all_mask[p], MCOMMIT, commit_payload,
+        st, ob = _commit_or_aggregate(
+            ctx, st, empty_outbox(MAX_OUT, MSG_W), 0, 1, p, dot, value, chosen
         )
         return st, ob, empty_execout(MAX_EXEC, EW)
 
     def h_mgc(ctx, st: TempoState, p, src, payload, now):
         st = st._replace(
-            gc=gc_mod.gc_handle_mgc(st.gc, p, src, payload[:n], pid=ctx.pid)
+            gc=gc_mod.gc_handle_mgc(
+                st.gc, p, src, payload[:n], pid=ctx.pid,
+                peers_mask=ctx.env.all_mask[p],
+            )
         )
         return st, empty_outbox(MAX_OUT, MSG_W), empty_execout(MAX_EXEC, EW)
 
@@ -486,6 +598,9 @@ def make_protocol(
                 h_mconsensus,
                 h_mconsensusack,
                 h_mgc,
+                h_mfwd,
+                h_mshardc,
+                h_mshardagg,
             )
         ]
         return jax.lax.switch(kind, branches, st, p, src, payload, now)
@@ -514,10 +629,18 @@ def make_protocol(
         for k in range(K):
             old = clocks[p, k]
             votes = old < up_to
+            if shards > 1:
+                # only own-shard keys: a clock must never advance without
+                # its matching vote (stability would stall on ghost clocks)
+                votes = votes & (
+                    jnp.int32(k % shards) == ctx.env.shard_of[ctx.pid]
+                )
             ob = outbox_row(
                 ob, k, votes, ctx.env.all_mask[p], MDETACHED, [jnp.int32(k), old + 1, up_to]
             )
-            clocks = clocks.at[p, k].set(jnp.maximum(old, up_to))
+            clocks = clocks.at[p, k].set(
+                jnp.where(votes, jnp.maximum(old, up_to), old)
+            )
         return st._replace(clocks=clocks), ob
 
     def metrics(st: TempoState):
@@ -536,6 +659,7 @@ def make_protocol(
 
     return ProtocolDef(
         name="tempo",
+        shards=shards,
         n_msg_kinds=N_KINDS,
         msg_width=MSG_W,
         max_out=MAX_OUT,

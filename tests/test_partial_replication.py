@@ -102,3 +102,56 @@ def test_mismatched_shard_instance_rejected():
     pdef = basic_proto.make_protocol(6, 1)  # shards defaulted to 1
     with pytest.raises(AssertionError, match="built for 1 shard"):
         setup.build_spec(config, wl, pdef, n_clients=2, n_client_groups=2)
+
+
+def run_tempo_shards(shards, kpc, conflict, cmds=15):
+    planet = Planet.new()
+    config = Config(n=3, f=1, shard_count=shards, gc_interval_ms=100)
+    wl = Workload(
+        shard_count=shards,
+        key_gen=KeyGen.conflict_pool(conflict_rate=conflict, pool_size=2),
+        keys_per_command=kpc,
+        commands_per_client=cmds,
+    )
+    pdef = tempo_proto.make_protocol(
+        config.n * shards, wl.keys_per_command, shards=shards
+    )
+    client_regions = ["us-west1", "us-west2"]
+    spec = setup.build_spec(
+        config, wl, pdef, n_clients=2, n_client_groups=2,
+        extra_ms=1000, max_steps=5_000_000,
+    )
+    placement = setup.Placement(
+        ["asia-east1", "us-central1", "us-west1"], client_regions, 1
+    )
+    env = setup.build_env(spec, config, planet, placement, wl, pdef)
+    st = jax.jit(lockstep.make_run(spec, pdef, wl))(env)
+    st = jax.tree_util.tree_map(np.asarray, st)
+    summary.check_sim_health(st)
+    return st, env, spec
+
+
+def test_tempo_two_shards_single_key_commands():
+    st, env, spec = run_tempo_shards(shards=2, kpc=1, conflict=50)
+    assert int(st.c_done.sum()) == 2
+    np.testing.assert_array_equal(st.lat_cnt, 15)
+    used = st.next_seq - 1
+    assert used[:3].sum() > 0 and used[3:].sum() > 0, used
+
+
+def test_tempo_two_shards_spanning_commands():
+    # kpc=2 over a 2-key pool: commands span both shards, exercising
+    # MForwardSubmit + MShardCommit aggregation + per-shard stability
+    st, env, spec = run_tempo_shards(shards=2, kpc=2, conflict=50)
+    assert int(st.c_done.sum()) == 2
+    np.testing.assert_array_equal(st.lat_cnt, 15)
+    commits = np.asarray(st.proto.commit_count)
+    assert (commits[:3] > 0).all() and (commits[3:] > 0).all(), commits
+
+
+def test_tempo_single_shard_goldens_unchanged():
+    st, env, spec = run_tempo_shards(shards=1, kpc=1, conflict=100)
+    assert int(st.c_done.sum()) == 2
+    # n=3 f=1 always takes the fast path (protocol/mod.rs expectations)
+    assert int(np.asarray(st.proto.slow_count).sum()) == 0
+    assert int(np.asarray(st.proto.fast_count).sum()) > 0
