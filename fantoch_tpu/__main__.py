@@ -30,6 +30,15 @@ def cmd_sim(args) -> int:
     from .plot.db import ResultsDB
     from .plot.plots import sim_output_stats
 
+    if args.batch > 1:
+        if not args.open_loop:
+            print("sim: --batch needs --open-loop (closed loops have one"
+                  " outstanding command; nothing to merge)", file=sys.stderr)
+            return 2
+        if args.batch_delay < 1:
+            print("sim: --batch needs --batch-delay >= 1", file=sys.stderr)
+            return 2
+
     pt = Point(
         protocol=args.protocol,
         n=args.n,
@@ -40,6 +49,9 @@ def cmd_sim(args) -> int:
         commands_per_client=args.commands,
         read_only_percentage=args.read_only,
         seed=args.seed,
+        open_loop_interval_ms=args.open_loop,
+        batch_max_size=args.batch,
+        batch_max_delay_ms=args.batch_delay,
     )
     dirs = run_grid(
         [pt],
@@ -287,6 +299,11 @@ def main(argv=None) -> int:
     ps.add_argument("--commands", type=int, default=100)
     ps.add_argument("--read-only", type=int, default=0)
     ps.add_argument("--seed", type=int, default=0)
+    ps.add_argument("--open-loop", type=int, default=0,
+                    help="open-loop tick interval ms (0 = closed loop)")
+    ps.add_argument("--batch", type=int, default=1, help="batch_max_size")
+    ps.add_argument("--batch-delay", type=int, default=0,
+                    help="batch_max_delay_ms")
     ps.add_argument("--process-regions", default="")
     ps.add_argument("--client-regions", default="")
     ps.add_argument("--results", default="results")

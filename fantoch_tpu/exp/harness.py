@@ -52,6 +52,10 @@ class Point:
     read_only_percentage: int = 0
     payload_size: int = 100
     seed: int = 0
+    # open-loop clients + client-side batching (0 interval = closed loop)
+    open_loop_interval_ms: int = 0
+    batch_max_size: int = 1
+    batch_max_delay_ms: int = 0
 
     def search(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)
@@ -112,6 +116,9 @@ def _bucket_key(pt: Point) -> Tuple:
         pt.keys_per_command,
         pt.commands_per_client,
         pt.pool_size,
+        pt.open_loop_interval_ms,
+        pt.batch_max_size,
+        pt.batch_max_delay_ms,
     )
 
 
@@ -156,7 +163,7 @@ def run_grid(
         pdef = make_protocol_def(
             pt0.protocol,
             n,
-            pt0.keys_per_command,
+            setup.command_key_slots(wl, pt0.batch_max_size),
             max_seq=max_seq,
             key_space_hint=wl.key_space(C),
         )
@@ -180,6 +187,9 @@ def run_grid(
                     max_seq=max_seq,
                     extra_ms=extra_ms,
                     max_steps=max_steps,
+                    open_loop_interval_ms=pt0.open_loop_interval_ms or None,
+                    batch_max_size=pt0.batch_max_size,
+                    batch_max_delay_ms=pt0.batch_max_delay_ms,
                 )
             envs.append(
                 setup.build_env(

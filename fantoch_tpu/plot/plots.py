@@ -213,3 +213,33 @@ def metrics_table(
             vals = " ".join(f"{int(v):>8}" for v in np.asarray(arr).ravel())
             lines.append(f"  {name:<10} {vals}")
     return "\n".join(lines)
+
+
+def batching_plot(
+    series: Dict[str, Sequence[ExperimentData]],
+    output: str,
+    x_key: str = "batch_max_size",
+) -> str:
+    """Throughput and avg latency vs batch size (`batching_plot`,
+    `fantoch_plot/src/lib.rs` — the reference plots both per batch knob)."""
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(9, 3.5))
+    for name, entries in series.items():
+        pts = sorted(
+            (e.search[x_key], e.throughput_cmds_per_sec, e.global_latency.mean())
+            for e in entries
+        )
+        if not pts:
+            continue
+        xs = [p[0] for p in pts]
+        ax1.plot(xs, [p[1] for p in pts], marker="o", markersize=3, label=name)
+        ax2.plot(xs, [p[2] for p in pts], marker="o", markersize=3, label=name)
+    ax1.set_xlabel(x_key)
+    ax1.set_ylabel("throughput (cmds/s)")
+    ax2.set_xlabel(x_key)
+    ax2.set_ylabel("avg latency (ms)")
+    for ax in (ax1, ax2):
+        ax.grid(alpha=0.3)
+        ax.legend(fontsize=7)
+    fig.savefig(output, bbox_inches="tight", dpi=150)
+    plt.close(fig)
+    return output

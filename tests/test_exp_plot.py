@@ -63,3 +63,37 @@ def test_grid_db_plots(tmp_path):
         plots.heatmap_plot(basics, "conflict", "seed", out + "/hm.png")
     )
     assert "commits" in plots.metrics_table([e])
+
+
+def test_batching_grid_and_plot(tmp_path):
+    """Open-loop batching through the harness: larger batches use fewer
+    dots; the batching_plot renders from the results DB."""
+    from fantoch_tpu.exp.harness import Point, run_grid
+    from fantoch_tpu.plot.db import ResultsDB
+    from fantoch_tpu.plot.plots import batching_plot
+
+    points = [
+        Point(
+            protocol="basic", n=3, f=1, commands_per_client=12,
+            conflict_rate=100, open_loop_interval_ms=2,
+            batch_max_size=b, batch_max_delay_ms=20 if b > 1 else 0,
+        )
+        for b in (1, 4)
+    ]
+    run_grid(
+        points,
+        process_regions=["asia-east1", "us-central1", "us-west1"],
+        results_root=str(tmp_path),
+        name="batching",
+    )
+    db = ResultsDB.load(str(tmp_path))
+    assert len(db) == 2
+    by_batch = {e.search["batch_max_size"]: e for e in db}
+    # every logical command completed in both runs
+    assert by_batch[1].global_latency.count() == 2 * 12
+    assert by_batch[4].global_latency.count() == 2 * 12
+    out = batching_plot(
+        {"basic": list(db)}, str(tmp_path / "batching.png")
+    )
+    import os
+    assert os.path.getsize(out) > 0

@@ -93,3 +93,43 @@ def test_quantum_runner_matches_event_engine():
     np.testing.assert_array_equal(
         np.asarray(rst.proto.gc.stable_count), np.asarray(st.proto.gc.stable_count)
     )
+
+
+def test_quantum_runner_matches_event_engine_tempo():
+    """The runner is protocol-generic: the flagship protocol (Tempo, with
+    its table executor, detached votes, and synod slow path) produces the
+    same histograms and protocol counters as the event engine."""
+    from fantoch_tpu.protocols import tempo as tempo_proto
+
+    n = 8
+    planet = Planet.new()
+    config = Config(n=n, f=1, gc_interval_ms=100)
+    wl = Workload(1, KeyGen.conflict_pool(50, 2), 1, 8)
+    pdef = tempo_proto.make_protocol(n, 1)
+    spec = setup.build_spec(
+        config, wl, pdef, n_clients=2, n_client_groups=2,
+        extra_ms=1000, max_steps=5_000_000,
+    )
+    placement = setup.Placement(PROCESS_REGIONS[:n], CLIENT_REGIONS, 1)
+    env = setup.build_env(spec, config, planet, placement, wl, pdef)
+
+    st = jax.jit(lockstep.make_run(spec, pdef, wl))(env)
+    st = jax.tree_util.tree_map(np.asarray, st)
+    summary.check_sim_health(st)
+
+    runner = quantum.build_runner(spec, pdef, wl, env)
+    mesh = quantum.make_mesh(n)
+    rst = runner.run_sharded(mesh, runner.init_state())
+    rst = jax.tree_util.tree_map(np.asarray, rst)
+
+    assert int(rst.dropped.sum()) == 0 and bool(rst.all_done)
+    np.testing.assert_array_equal(rst.hist.sum(axis=0), st.hist)
+    np.testing.assert_array_equal(
+        np.asarray(rst.proto.commit_count), np.asarray(st.proto.commit_count)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(rst.proto.fast_count), np.asarray(st.proto.fast_count)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(rst.proto.slow_count), np.asarray(st.proto.slow_count)
+    )
