@@ -95,17 +95,12 @@ def test_quantum_runner_matches_event_engine():
     )
 
 
-def test_quantum_runner_matches_event_engine_tempo():
-    """The runner is protocol-generic: the flagship protocol (Tempo, with
-    its table executor, detached votes, and synod slow path) produces the
-    same histograms and protocol counters as the event engine."""
-    from fantoch_tpu.protocols import tempo as tempo_proto
-
-    n = 8
+def _run_both_engines(pdef, config):
+    """Run one n=8 config under the event engine and the quantum runner;
+    returns (engine_state, runner_state) as numpy pytrees."""
+    n = config.n
     planet = Planet.new()
-    config = Config(n=n, f=1, gc_interval_ms=100)
     wl = Workload(1, KeyGen.conflict_pool(50, 2), 1, 8)
-    pdef = tempo_proto.make_protocol(n, 1)
     spec = setup.build_spec(
         config, wl, pdef, n_clients=2, n_client_groups=2,
         extra_ms=1000, max_steps=5_000_000,
@@ -121,15 +116,39 @@ def test_quantum_runner_matches_event_engine_tempo():
     mesh = quantum.make_mesh(n)
     rst = runner.run_sharded(mesh, runner.init_state())
     rst = jax.tree_util.tree_map(np.asarray, rst)
-
     assert int(rst.dropped.sum()) == 0 and bool(rst.all_done)
     np.testing.assert_array_equal(rst.hist.sum(axis=0), st.hist)
-    np.testing.assert_array_equal(
-        np.asarray(rst.proto.commit_count), np.asarray(st.proto.commit_count)
+    return st, rst
+
+
+def test_quantum_runner_matches_event_engine_tempo():
+    """The runner is protocol-generic: the flagship protocol (Tempo, with
+    its table executor, detached votes, and synod slow path) produces the
+    same histograms and protocol counters as the event engine."""
+    from fantoch_tpu.protocols import tempo as tempo_proto
+
+    st, rst = _run_both_engines(
+        tempo_proto.make_protocol(8, 1), Config(n=8, f=1, gc_interval_ms=100)
     )
-    np.testing.assert_array_equal(
-        np.asarray(rst.proto.fast_count), np.asarray(st.proto.fast_count)
+    for counter in ("commit_count", "fast_count", "slow_count"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(rst.proto, counter)),
+            np.asarray(getattr(st.proto, counter)),
+        )
+
+
+def test_quantum_runner_matches_event_engine_fpaxos():
+    """Leader-based routing under the runner: submit forwarding to the
+    leader device, the commander/acceptor flow, and the write-quorum GC
+    stability path match the event engine exactly."""
+    from fantoch_tpu.protocols import fpaxos as fpaxos_proto
+
+    st, rst = _run_both_engines(
+        fpaxos_proto.make_protocol(8, 1),
+        Config(n=8, f=1, gc_interval_ms=100, leader=1),
     )
-    np.testing.assert_array_equal(
-        np.asarray(rst.proto.slow_count), np.asarray(st.proto.slow_count)
-    )
+    for counter in ("commit_count", "stable_count"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(rst.proto, counter)),
+            np.asarray(getattr(st.proto, counter)),
+        )
