@@ -110,6 +110,7 @@ class TempoState(NamedTuple):
     gc: gc_mod.GCTrack
     fast_count: jnp.ndarray  # [n] int32
     slow_count: jnp.ndarray  # [n] int32
+    slow_read_count: jnp.ndarray  # [n] int32 slow paths taken by reads (NFR)
     commit_count: jnp.ndarray  # [n] int32
 
 
@@ -167,6 +168,7 @@ def make_protocol(
             gc=gc_mod.gc_init(n, DOTS),
             fast_count=z(n),
             slow_count=z(n),
+            slow_read_count=z(n),
             commit_count=z(n),
         )
 
@@ -480,6 +482,9 @@ def make_protocol(
             ),
             fast_count=st.fast_count.at[p].add(fast.astype(jnp.int32)),
             slow_count=st.slow_count.at[p].add(slow.astype(jnp.int32)),
+            slow_read_count=st.slow_read_count.at[p].add(
+                (slow & ctx.cmds.read_only[dot]).astype(jnp.int32)
+            ),
         )
         ob = outbox_row(
             ob, 0, slow, ctx.env.wq_mask[p], MCONSENSUS,
@@ -648,6 +653,7 @@ def make_protocol(
             "stable": st.gc.stable_count,
             "commits": st.commit_count,
             "fast": st.fast_count,
+            "slow_reads": st.slow_read_count,
             "slow": st.slow_count,
         }
 

@@ -86,3 +86,37 @@ def test_runner_multiple_clients_per_process():
         # all-identical latencies: cov is 0/undefined spread; compare stddev
         assert lat1[region][1].stddev() == lat3[region][1].stddev()
     check_gc_complete(m3, 3)
+
+
+def test_zipf_workload_end_to_end():
+    """Zipf key generation drives a full simulation (the reference's other
+    KeyGen, `client/key_gen.rs`): commands complete and keys spread over
+    the zipf keyspace with rank-1 most popular."""
+    planet = Planet.new()
+    config = Config(n=3, f=1, gc_interval_ms=100)
+    workload = Workload(
+        shard_count=1,
+        key_gen=KeyGen.zipf(coefficient=1.0, total_keys_per_shard=32),
+        keys_per_command=1,
+        commands_per_client=40,
+    )
+    pdef = basic_proto.make_protocol(config.n, 1)
+    spec = setup.build_spec(
+        config, workload, pdef, n_clients=4, n_client_groups=2,
+        extra_ms=1000, max_steps=5_000_000,
+    )
+    placement = setup.Placement(
+        ["asia-east1", "us-central1", "us-west1"], ["us-west1", "us-west2"], 2
+    )
+    env = setup.build_env(spec, config, planet, placement, workload, pdef)
+    st = jax.jit(lockstep.make_run(spec, pdef, workload))(env)
+    st = jax.tree_util.tree_map(np.asarray, st)
+    summary.check_sim_health(st)
+    np.testing.assert_array_equal(st.lat_cnt, 40)
+    # key usage is zipf-spread: multiple keys touched, none out of range
+    used_keys = st.cmd_keys[st.cmd_rifl > 0].ravel()
+    assert (used_keys >= 0).all() and (used_keys < 32).all()
+    assert len(np.unique(used_keys)) > 3
+    # rank-0 is the most frequent key (zipf with coefficient 1)
+    counts = np.bincount(used_keys, minlength=32)
+    assert counts[0] == counts.max(), counts

@@ -129,3 +129,22 @@ def test_tempo_n3_f1_reorder():
 def test_tempo_multi_key():
     st, metrics, spec = run(3, 1, keys_per_command=2, conflict_rate=50)
     check(st, metrics, spec, keys_per_command=2)
+
+
+def test_tempo_n5_f2_nfr_reads_never_slow():
+    """Reference `sim_tempo_5_2_nfr_test` (protocol/mod.rs:169-184): with
+    NFR on, 20% single-key reads, n=5 f=2 — slow paths happen, but never
+    for a read (reads use a plain majority and don't bump clocks)."""
+    st, metrics, spec = run(
+        n=5, f=2, conflict_rate=50, nfr=True, read_only_percentage=20
+    )
+    # NB: no cross-replica order check here — NFR deliberately gives up a
+    # total order between concurrent reads, so per-key execution positions
+    # of reads differ across replicas (the reference's NFR test likewise
+    # asserts only the path counts, protocol/mod.rs:169-184)
+    total = spec.n_clients * COMMANDS_PER_CLIENT
+    assert (metrics["commits"] == total).all()
+    slow = int(metrics["slow"].sum())
+    slow_reads = int(metrics["slow_reads"].sum())
+    assert slow > 0
+    assert slow_reads == 0, slow_reads
