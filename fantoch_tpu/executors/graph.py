@@ -30,6 +30,16 @@ boolean matrix squaring (int matmuls — MXU-shaped on TPU):
 
 Execution-info row (width 1 + MAX_DEPS): ``[dot, dep_0+1 .. dep_D+1]``
 (0 = empty slot) — `GraphExecutionInfo::Add` (`graph/executor.rs:198`).
+
+Partial replication (`shards` > 1): a process only applies/answers its own
+shard's keys, and a dependency whose command does not touch this shard will
+never commit here — the reference requests the missing vertex from the dep's
+shard and ingests the reply as a remote vertex (`executor/graph/mod.rs:34-43`
+`RequestReply::{Info,Executed}`, `out_requests`/`buffered_in_requests`).
+Here the executor surfaces missing remote deps through the periodic
+executed-notification channel (`Executor::executed` →
+`Protocol::handle_executed`); the protocol ships the request/reply as
+protocol messages and feeds the reply back as a regular execution info.
 """
 from __future__ import annotations
 
@@ -40,9 +50,13 @@ import jax.numpy as jnp
 
 from ..engine.types import ExecutorDef
 from ..ops.closure import transitive_closure
+from ..protocols.common.sharding import key_shard
 from .ready import ReadyRing, ready_capacity, ready_drain, ready_init, ready_push, writer_id
 
 ORDER_HASH_MULT = jnp.int32(0x01000193)
+
+# missing-dep request slots surfaced per executed-notification tick
+MAX_REQS = 8
 
 
 class GraphExecState(NamedTuple):
@@ -54,10 +68,11 @@ class GraphExecState(NamedTuple):
     order_cnt: jnp.ndarray  # [n, K] int32
     executed_count: jnp.ndarray  # [n] int32 commands executed
     chain_max: jnp.ndarray  # [n] int32 largest ready batch (ChainSize metric)
+    requested: jnp.ndarray  # [n, DOTS] bool cross-shard dep request sent
     ready: ReadyRing
 
 
-def make_executor(n: int, max_deps: int) -> ExecutorDef:
+def make_executor(n: int, max_deps: int, shards: int = 1) -> ExecutorDef:
     D = max_deps
     EW = 1 + D
 
@@ -72,6 +87,7 @@ def make_executor(n: int, max_deps: int) -> ExecutorDef:
             order_cnt=jnp.zeros((n, spec.key_space), jnp.int32),
             executed_count=jnp.zeros((n,), jnp.int32),
             chain_max=jnp.zeros((n,), jnp.int32),
+            requested=jnp.zeros((n, DOTS), jnp.bool_),
             ready=ready_init(n, ready_capacity(spec)),
         )
 
@@ -120,10 +136,22 @@ def make_executor(n: int, max_deps: int) -> ExecutorDef:
             kvs, oh, oc, ready = e.kvs, e.order_hash, e.order_cnt, e.ready
             for k in range(KPC):
                 key = ctx.cmds.keys[d, k]
-                kvs = kvs.at[p, key].set(writer_id(client, rifl))
-                oh = oh.at[p, key].set(oh[p, key] * ORDER_HASH_MULT + (d + 1))
-                oc = oc.at[p, key].add(1)
-                ready = ready_push(ready, p, client, rifl)
+                # partial replication: apply and answer only this shard's
+                # keys; remote-fetched vertices execute as ordering-only
+                # no-ops (the dep's own shard serves its client results)
+                owned = (
+                    jnp.bool_(True)
+                    if shards == 1
+                    else key_shard(key, shards) == ctx.env.shard_of[ctx.pid]
+                )
+                kvs = kvs.at[p, key].set(
+                    jnp.where(owned, writer_id(client, rifl), kvs[p, key])
+                )
+                oh = oh.at[p, key].set(
+                    jnp.where(owned, oh[p, key] * ORDER_HASH_MULT + (d + 1), oh[p, key])
+                )
+                oc = oc.at[p, key].add(owned.astype(jnp.int32))
+                ready = ready_push(ready, p, client, rifl, enable=owned)
             e = e._replace(
                 kvs=kvs,
                 order_hash=oh,
@@ -149,10 +177,47 @@ def make_executor(n: int, max_deps: int) -> ExecutorDef:
         ready, res = ready_drain(est.ready, p, ctx.spec.max_res)
         return est._replace(ready=ready), res
 
+    def executed(ctx, est: GraphExecState, p):
+        """Surface up to MAX_REQS missing *remote* dependencies — deps of
+        committed-but-unexecuted vertices that are neither committed nor
+        executed here and whose command touches no local key (so this
+        shard's own agreement will never deliver them). The protocol turns
+        each into a dep-request to the dep's shard (the device analogue of
+        `DependencyGraph::out_requests`, `executor/graph/mod.rs:59`)."""
+        DOTS = est.committed.shape[1]
+        dots = jnp.arange(DOTS, dtype=jnp.int32)
+        V = est.committed[p] & ~est.executed[p]
+        dep = est.deps[p]  # [DOTS, D]
+        has_dep = dep > 0
+        tgt = jnp.clip(dep - 1, 0, DOTS - 1)
+        unknown = has_dep & ~(est.committed[p][tgt] | est.executed[p][tgt]) & V[:, None]
+        # missing[d] = some unexecuted vertex depends on unknown dot d
+        missing = (
+            jnp.zeros((DOTS,), jnp.bool_)
+            .at[jnp.where(unknown, tgt, DOTS)]
+            .max(unknown, mode="drop")
+        )
+        # remote = the dep's command has no key in my shard
+        ks = key_shard(ctx.cmds.keys, shards)  # [DOTS, KPC]
+        local = (ks == ctx.env.shard_of[ctx.pid]).any(axis=1)
+        cand = missing & ~local & ~est.requested[p]
+        # pick the first MAX_REQS candidates (dot order)
+        idx = jnp.cumsum(cand.astype(jnp.int32)) - 1
+        row = (
+            jnp.zeros((MAX_REQS,), jnp.int32)
+            .at[jnp.where(cand & (idx < MAX_REQS), idx, MAX_REQS)]
+            .set(dots + 1, mode="drop")
+        )
+        take = cand & (idx < MAX_REQS)
+        est = est._replace(requested=est.requested.at[p].set(est.requested[p] | take))
+        return est, row
+
     return ExecutorDef(
         name="graph",
         exec_width=EW,
         init=init,
         handle=handle,
         drain=drain,
+        executed_width=MAX_REQS if shards > 1 else 0,
+        executed=executed if shards > 1 else None,
     )

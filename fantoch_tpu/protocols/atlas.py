@@ -29,6 +29,15 @@ flat dot + 1, 0 = empty):
 - MCONSENSUS    [dot, ballot, deps x D]
 - MCONSENSUSACK [dot, ballot]
 - MGC           [frontier_0 .. frontier_{n-1}]
+
+Partial replication (`shards` > 1; reference `protocol/partial.rs` plus the
+atlas.rs MShardCommit handlers and `executor/graph/mod.rs:34-43` dep
+requests) adds:
+- MFWD       [dot]            submit forwarded to each other touched shard
+- MSHARDC    [dot, deps x D]  shard-local committed deps -> dot coordinator
+- MSHARDAGG  [dot, deps x D]  cross-shard union -> each shard coordinator
+- MDEPREQ    [dot]            executor's missing remote dependency request
+- MDEPREPLY  [dot, deps x D]  the dep's committed deps (RequestReply::Info)
 """
 from __future__ import annotations
 
@@ -46,9 +55,11 @@ from ..engine.types import (
     empty_outbox,
     outbox_row,
 )
+from ..core.ids import dot_proc
 from ..executors import graph as graph_executor
 from .common import deps as deps_mod
 from .common import gc as gc_mod
+from .common import sharding
 from .common import synod as synod_mod
 
 MCOLLECT = 0
@@ -57,7 +68,11 @@ MCOMMIT = 2
 MCONSENSUS = 3
 MCONSENSUSACK = 4
 MGC = 5
-N_KINDS = 6
+MFWD = 6
+MSHARDC = 7
+MSHARDAGG = 8
+MDEPREQ = 9
+MDEPREPLY = 10
 
 START = 0
 PAYLOAD = 1
@@ -80,23 +95,36 @@ class AtlasState(NamedTuple):
     fast_count: jnp.ndarray  # [n] int32
     slow_count: jnp.ndarray  # [n] int32
     commit_count: jnp.ndarray  # [n] int32
+    # partial replication only (shape (1,1)/(1,1,1) dummies when shards == 1):
+    # multi-shard commit aggregation at the dot's coordinator (ShardsCommits)
+    sc_cnt: jnp.ndarray  # [n, DOTS] int32 shard dep-sets received
+    sc_deps: jnp.ndarray  # [n, DOTS, D] int32 cross-shard dep union
+    # dep requests that arrived before this dot committed locally
+    # (buffered_in_requests, executor/graph/mod.rs:64): requester bitmask
+    reqpend: jnp.ndarray  # [n, DOTS] int32
 
 
-def _make(variant: str, n: int, keys_per_command: int, nfr: bool) -> ProtocolDef:
+def _make(
+    variant: str, n: int, keys_per_command: int, nfr: bool, shards: int = 1
+) -> ProtocolDef:
     assert variant in ("atlas", "epaxos", "janus")
     KPC = keys_per_command
+    ranks = n // shards  # replicas per shard
+    assert ranks * shards == n
     D = deps_mod.max_union_deps(n, KPC)
     # Janus == Atlas (commit with all deps; README.md:11)
     self_ack = variant != "epaxos"
     MSG_W = max(2 + D, n)
-    MAX_OUT = 1
+    MAX_OUT = 1 if shards == 1 else max(shards + 1, 3)
     MAX_EXEC = 1
-    exdef = graph_executor.make_executor(n, D)
+    N_KINDS = 6 if shards == 1 else 11
+    exdef = graph_executor.make_executor(n, D, shards)
     EW = exdef.exec_width
 
     def init(spec, env):
         DOTS = spec.dots
         z = lambda *shape: jnp.zeros(shape, jnp.int32)
+        multi = shards > 1
         return AtlasState(
             kd=deps_mod.keydeps_init(n, spec.key_space),
             status=z(n, DOTS),
@@ -112,19 +140,24 @@ def _make(variant: str, n: int, keys_per_command: int, nfr: bool) -> ProtocolDef
             fast_count=z(n),
             slow_count=z(n),
             commit_count=z(n),
+            sc_cnt=z(n, DOTS) if multi else z(1, 1),
+            sc_deps=z(n, DOTS, D) if multi else z(1, 1, 1),
+            reqpend=z(n, DOTS) if multi else z(1, 1),
         )
 
     def _add_cmd(ctx, st: AtlasState, p, dot, past, enable):
         keys = ctx.cmds.keys[dot]
+        slot_en = sharding.slot_mask(ctx, dot, shards) if shards > 1 else None
         kd, deps, overflow = deps_mod.add_cmd(
             st.kd, p, dot, keys, ctx.cmds.read_only[dot], past,
-            st.dep_overflow, enable, nfr,
+            st.dep_overflow, enable, nfr, slot_en=slot_en,
         )
         return st._replace(kd=kd, dep_overflow=overflow), deps
 
-    def _commit(ctx, st: AtlasState, p, dot, deps, enable):
+    def _commit(ctx, st: AtlasState, p, dot, deps, enable, ob=None, row=0):
         """Commit path (atlas.rs:392-453): mark COMMIT, hand the dep set to
-        the graph executor, record for GC."""
+        the graph executor, record for GC; answer dep requests that were
+        buffered waiting for this commit (buffered_in_requests)."""
         st = st._replace(
             status=st.status.at[p, dot].set(
                 jnp.where(enable, COMMIT, st.status[p, dot])
@@ -133,14 +166,45 @@ def _make(variant: str, n: int, keys_per_command: int, nfr: bool) -> ProtocolDef
                 jnp.where(enable, deps, st.acc_deps[p, dot])
             ),
             commit_count=st.commit_count.at[p].add(enable.astype(jnp.int32)),
-            gc=gc_mod.gc_commit(st.gc, p, dot, enable, ctx.spec.max_seq),
+            gc=gc_mod.gc_commit(
+                st.gc, p, dot,
+                enable & sharding.own_coord(ctx, dot, shards),
+                ctx.spec.max_seq,
+            ),
         )
+        if shards > 1 and ob is not None:
+            pending = st.reqpend[p, dot]
+            ob = outbox_row(
+                ob, row, enable & (pending != 0), pending, MDEPREPLY,
+                [dot] + list(deps),
+            )
+            st = st._replace(
+                reqpend=st.reqpend.at[p, dot].set(
+                    jnp.where(enable, 0, pending)
+                )
+            )
         info = jnp.concatenate([dot[None], deps]).astype(jnp.int32)
         execout = ExecOut(
             valid=jnp.broadcast_to(enable, (MAX_EXEC,)),
             info=info[None, :],
         )
-        return st, execout
+        return st, execout, ob
+
+    def _commit_or_aggregate(ctx, st: AtlasState, ob, row, p, dot, deps, enable):
+        """Single-shard commands broadcast `MCommit` in-shard; multi-shard
+        commands send their shard-local dep set to the dot's coordinator for
+        cross-shard union (partial.rs mcommit_actions)."""
+        pay = [dot] + list(deps)
+        if shards == 1:
+            return outbox_row(ob, row, enable, ctx.env.all_mask[p], MCOMMIT, pay)
+        single = sharding.shard_touch(ctx, dot, shards).sum() <= 1
+        ob = outbox_row(
+            ob, row, enable & single, ctx.env.all_mask[p], MCOMMIT, pay
+        )
+        agg = dot_proc(dot, ctx.spec.max_seq)
+        return outbox_row(
+            ob, row + 1, enable & ~single, jnp.int32(1) << agg, MSHARDC, pay
+        )
 
     def submit(ctx, st: AtlasState, p, dot, now):
         st, deps = _add_cmd(
@@ -151,6 +215,15 @@ def _make(variant: str, n: int, keys_per_command: int, nfr: bool) -> ProtocolDef
             jnp.bool_(True), ctx.env.all_mask[p], MCOLLECT,
             [dot, ctx.env.fq_mask[p]] + list(deps),
         )
+        # forward the submit to every other shard the command touches
+        # (partial.rs submit_actions)
+        if shards > 1:
+            myshard = ctx.env.shard_of[ctx.pid]
+            touch = sharding.shard_touch(ctx, dot, shards)
+            for t in range(shards):
+                en = touch[t] & (jnp.int32(t) != myshard)
+                tgt = jnp.int32(1) << ctx.env.closest_shard_proc[p, t]
+                ob = outbox_row(ob, 1 + t, en, tgt, MFWD, [dot])
         return st, ob, empty_execout(MAX_EXEC, EW)
 
     def h_mcollect(ctx, st: AtlasState, p, src, payload, now):
@@ -195,7 +268,9 @@ def _make(variant: str, n: int, keys_per_command: int, nfr: bool) -> ProtocolDef
         st = st._replace(
             bufc_valid=st.bufc_valid.at[p, dot].set(st.bufc_valid[p, dot] & ~flush)
         )
-        st, execout = _commit(ctx, st, p, dot, st.bufc_deps[p, dot], flush)
+        st, execout, ob = _commit(
+            ctx, st, p, dot, st.bufc_deps[p, dot], flush, ob=ob, row=1
+        )
         return st, ob, execout
 
     def h_mcollectack(ctx, st: AtlasState, p, src, payload, now):
@@ -207,8 +282,9 @@ def _make(variant: str, n: int, keys_per_command: int, nfr: bool) -> ProtocolDef
         count = st.qd.count[p, dot]
         all_in = collect & (count == st.qsize[p, dot])
         if self_ack:
-            # Atlas: every dep reported >= quorum - minority times
-            threshold = st.qsize[p, dot] - n // 2
+            # Atlas: every dep reported >= quorum - minority times (the
+            # minority of this shard's replica group, config.rs:295-302)
+            threshold = st.qsize[p, dot] - ranks // 2
         else:
             # EPaxos: all counted members reported identical deps
             threshold = st.qsize[p, dot]
@@ -226,20 +302,27 @@ def _make(variant: str, n: int, keys_per_command: int, nfr: bool) -> ProtocolDef
             fast_count=st.fast_count.at[p].add(fast.astype(jnp.int32)),
             slow_count=st.slow_count.at[p].add(slow.astype(jnp.int32)),
         )
-        row_kind = jnp.where(fast, MCOMMIT, MCONSENSUS)
-        row_tgt = jnp.where(fast, ctx.env.all_mask[p], ctx.env.wq_mask[p])
-        commit_payload = jnp.concatenate([dot[None], union]).astype(jnp.int32)
-        cons_payload = jnp.concatenate(
-            [dot[None], (ctx.pid + 1)[None], union]
-        ).astype(jnp.int32)
-        width = cons_payload.shape[0]
-        commit_payload = jnp.concatenate(
-            [commit_payload, jnp.zeros((width - commit_payload.shape[0],), jnp.int32)]
-        )
-        pay = jnp.where(fast, commit_payload, cons_payload)
-        ob = outbox_row(
-            empty_outbox(MAX_OUT, MSG_W), 0, all_in, row_tgt, row_kind, list(pay)
-        )
+        ob = empty_outbox(MAX_OUT, MSG_W)
+        if shards == 1:
+            row_kind = jnp.where(fast, MCOMMIT, MCONSENSUS)
+            row_tgt = jnp.where(fast, ctx.env.all_mask[p], ctx.env.wq_mask[p])
+            commit_payload = jnp.concatenate([dot[None], union]).astype(jnp.int32)
+            cons_payload = jnp.concatenate(
+                [dot[None], (ctx.pid + 1)[None], union]
+            ).astype(jnp.int32)
+            width = cons_payload.shape[0]
+            commit_payload = jnp.concatenate(
+                [commit_payload,
+                 jnp.zeros((width - commit_payload.shape[0],), jnp.int32)]
+            )
+            pay = jnp.where(fast, commit_payload, cons_payload)
+            ob = outbox_row(ob, 0, all_in, row_tgt, row_kind, list(pay))
+        else:
+            ob = outbox_row(
+                ob, 0, slow, ctx.env.wq_mask[p], MCONSENSUS,
+                [dot, ctx.pid + 1] + list(union),
+            )
+            ob = _commit_or_aggregate(ctx, st, ob, 1, p, dot, union, fast)
         return st, ob, empty_execout(MAX_EXEC, EW)
 
     def h_mcommit(ctx, st: AtlasState, p, src, payload, now):
@@ -253,8 +336,11 @@ def _make(variant: str, n: int, keys_per_command: int, nfr: bool) -> ProtocolDef
                 jnp.where(is_start, deps, st.bufc_deps[p, dot])
             ),
         )
-        st, execout = _commit(ctx, st, p, dot, deps, can_commit)
-        return st, empty_outbox(MAX_OUT, MSG_W), execout
+        st, execout, ob = _commit(
+            ctx, st, p, dot, deps, can_commit,
+            ob=empty_outbox(MAX_OUT, MSG_W), row=0,
+        )
+        return st, ob, execout
 
     def h_mconsensus(ctx, st: AtlasState, p, src, payload, now):
         dot, ballot = payload[0], payload[1]
@@ -293,32 +379,137 @@ def _make(variant: str, n: int, keys_per_command: int, nfr: bool) -> ProtocolDef
         )
         chosen = chosen & not_committed
         st = st._replace(synod=sy)
-        ob = outbox_row(
-            empty_outbox(MAX_OUT, MSG_W), 0,
-            chosen, ctx.env.all_mask[p], MCOMMIT,
-            [dot] + list(st.prop_deps[p, dot]),
+        ob = _commit_or_aggregate(
+            ctx, st, empty_outbox(MAX_OUT, MSG_W), 0, p, dot,
+            st.prop_deps[p, dot], chosen,
         )
         return st, ob, empty_execout(MAX_EXEC, EW)
 
     def h_mgc(ctx, st: AtlasState, p, src, payload, now):
         st = st._replace(
-            gc=gc_mod.gc_handle_mgc(st.gc, p, src, payload[:n], pid=ctx.pid)
+            gc=gc_mod.gc_handle_mgc(
+                st.gc, p, src, payload[:n], pid=ctx.pid,
+                peers_mask=ctx.env.all_mask[p],
+            )
         )
         return st, empty_outbox(MAX_OUT, MSG_W), empty_execout(MAX_EXEC, EW)
 
-    def handle(ctx, st, p, src, kind, payload, now):
-        branches = [
-            functools.partial(h, ctx)
-            for h in (
-                h_mcollect,
-                h_mcollectack,
-                h_mcommit,
-                h_mconsensus,
-                h_mconsensusack,
-                h_mgc,
+    def h_mfwd(ctx, st: AtlasState, p, src, payload, now):
+        """MForwardSubmit at this shard's designated coordinator: compute the
+        shard-local dep set and start this shard's collect round."""
+        dot = payload[0]
+        st, deps = _add_cmd(
+            ctx, st, p, dot, jnp.zeros((D,), jnp.int32), jnp.bool_(True)
+        )
+        ob = outbox_row(
+            empty_outbox(MAX_OUT, MSG_W), 0,
+            jnp.bool_(True), ctx.env.all_mask[p], MCOLLECT,
+            [dot, ctx.env.fq_mask[p]] + list(deps),
+        )
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def h_mshardc(ctx, st: AtlasState, p, src, payload, now):
+        """MShardCommit at the aggregator (the dot's coordinator): union the
+        shard dep sets; once every touched shard reported, send the union
+        back to each shard's coordinator (partial.rs handle_mshard_commit +
+        atlas.rs add_shards_commits_info extending the dep set)."""
+        dot = payload[0]
+        rdeps = payload[1 : 1 + D]
+        row = st.sc_deps[p, dot]
+        overflow = st.dep_overflow
+        for j in range(D):
+            row, overflow = deps_mod.set_insert(
+                row, rdeps[j], jnp.bool_(True), overflow
             )
+        cnt = st.sc_cnt[p, dot] + 1
+        st = st._replace(
+            sc_cnt=st.sc_cnt.at[p, dot].set(cnt),
+            sc_deps=st.sc_deps.at[p, dot].set(row),
+            dep_overflow=overflow,
+        )
+        touch = sharding.shard_touch(ctx, dot, shards)
+        done = cnt == touch.sum()
+        # participants: the per-shard coordinators this dot's submit chose
+        tgt = jnp.int32(0)
+        for t in range(shards):
+            tgt = tgt | jnp.where(
+                touch[t], jnp.int32(1) << ctx.env.closest_shard_proc[p, t], 0
+            )
+        ob = outbox_row(
+            empty_outbox(MAX_OUT, MSG_W), 0, done, tgt, MSHARDAGG,
+            [dot] + list(row),
+        )
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def h_mshardagg(ctx, st: AtlasState, p, src, payload, now):
+        """MShardAggregatedCommit at a shard coordinator: broadcast the final
+        MCommit in this shard with the cross-shard dep union."""
+        ob = outbox_row(
+            empty_outbox(MAX_OUT, MSG_W), 0,
+            jnp.bool_(True), ctx.env.all_mask[p], MCOMMIT, list(payload[: 1 + D]),
+        )
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def h_mdepreq(ctx, st: AtlasState, p, src, payload, now):
+        """A remote executor asks for a dependency of ours it cannot see
+        (executor/graph Request). Reply Info{dot, deps} if committed here;
+        otherwise buffer the requester until the commit arrives."""
+        dot = payload[0]
+        committed = st.status[p, dot] == COMMIT
+        ob = outbox_row(
+            empty_outbox(MAX_OUT, MSG_W), 0,
+            committed, jnp.int32(1) << src, MDEPREPLY,
+            [dot] + list(st.acc_deps[p, dot]),
+        )
+        st = st._replace(
+            reqpend=st.reqpend.at[p, dot].set(
+                jnp.where(
+                    committed, st.reqpend[p, dot],
+                    st.reqpend[p, dot] | (jnp.int32(1) << src),
+                )
+            )
+        )
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def h_mdepreply(ctx, st: AtlasState, p, src, payload, now):
+        """RequestReply::Info — ingest the remote vertex into the local
+        dependency graph as a regular execution info (ordering-only: the
+        executor applies no non-local keys)."""
+        info = payload[: 1 + D].astype(jnp.int32)
+        execout = ExecOut(
+            valid=jnp.ones((MAX_EXEC,), jnp.bool_),
+            info=info[None, :],
+        )
+        return st, empty_outbox(MAX_OUT, MSG_W), execout
+
+    def handle(ctx, st, p, src, kind, payload, now):
+        hs = [
+            h_mcollect,
+            h_mcollectack,
+            h_mcommit,
+            h_mconsensus,
+            h_mconsensusack,
+            h_mgc,
         ]
+        if shards > 1:
+            hs += [h_mfwd, h_mshardc, h_mshardagg, h_mdepreq, h_mdepreply]
+        branches = [functools.partial(h, ctx) for h in hs]
         return jax.lax.switch(kind, branches, st, p, src, payload, now)
+
+    def handle_executed(ctx, st: AtlasState, p, info, now):
+        """Turn the executor's missing-remote-dep dots into MDEPREQ messages
+        addressed to the closest process of each dep's first touched shard
+        (DependencyGraph::out_requests drained to the network)."""
+        ob = empty_outbox(graph_executor.MAX_REQS, MSG_W)
+        for i in range(graph_executor.MAX_REQS):
+            dot = info[i] - 1
+            en = info[i] > 0
+            safe = jnp.clip(dot, 0, ctx.spec.dots - 1)
+            touch = sharding.shard_touch(ctx, safe, shards)
+            t = jnp.argmax(touch).astype(jnp.int32)
+            tgt = jnp.int32(1) << ctx.env.closest_shard_proc[p, t]
+            ob = outbox_row(ob, i, en, tgt, MDEPREQ, [safe])
+        return st, ob
 
     def periodic(ctx, st: AtlasState, p, kind, now):
         all_but_me = ctx.env.all_mask[p] & ~(jnp.int32(1) << ctx.pid)
@@ -356,15 +547,21 @@ def _make(variant: str, n: int, keys_per_command: int, nfr: bool) -> ProtocolDef
         handle=handle,
         periodic_events=(("garbage_collection", lambda cfg: cfg.gc_interval_ms),),
         periodic=periodic,
+        handle_executed=handle_executed if shards > 1 else None,
         quorum_sizes=quorum_sizes,
         leaderless=True,
+        shards=shards,
         metrics=metrics,
     )
 
 
-def make_protocol(n: int, keys_per_command: int = 1, nfr: bool = False) -> ProtocolDef:
-    return _make("atlas", n, keys_per_command, nfr)
+def make_protocol(
+    n: int, keys_per_command: int = 1, nfr: bool = False, shards: int = 1
+) -> ProtocolDef:
+    return _make("atlas", n, keys_per_command, nfr, shards)
 
 
-def make_janus(n: int, keys_per_command: int = 1, nfr: bool = False) -> ProtocolDef:
-    return _make("janus", n, keys_per_command, nfr)
+def make_janus(
+    n: int, keys_per_command: int = 1, nfr: bool = False, shards: int = 1
+) -> ProtocolDef:
+    return _make("janus", n, keys_per_command, nfr, shards)

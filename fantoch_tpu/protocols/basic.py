@@ -135,7 +135,11 @@ def make_protocol(n: int, keys_per_command: int = 1, shards: int = 1) -> Protoco
             ),
         )
         st = st._replace(
-            gc=gc_mod.gc_commit(st.gc, p, dot, enable, ctx.spec.max_seq),
+            gc=gc_mod.gc_commit(
+                st.gc, p, dot,
+                enable & sharding.own_coord(ctx, dot, shards),
+                ctx.spec.max_seq,
+            ),
             commit_count=st.commit_count.at[p].add(enable.astype(jnp.int32)),
         )
         return st, execout

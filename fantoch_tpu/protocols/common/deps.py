@@ -66,25 +66,32 @@ def add_cmd(
     overflow,
     enable,
     nfr: bool,
+    slot_en=None,  # optional [KPC] bool: key slots this process's shard owns
 ):
     """KeyDeps::add_cmd — collect deps from the per-key latests, then record
-    this command as the new latest write/read on each key."""
+    this command as the new latest write/read on each key.
+
+    With partial replication a process only tracks its own shard's keys
+    (`cmd.keys(shard_id)`, `keys/mod.rs:44-75`): pass the ownership mask as
+    `slot_en` and non-owned slots neither contribute nor record latests.
+    """
     kpc = len(keys) if isinstance(keys, (list, tuple)) else keys.shape[0]
     enable = jnp.asarray(enable)
     lw, lr = kd.latest_w, kd.latest_r
     for i in range(kpc):
+        en = enable if slot_en is None else enable & slot_en[i]
         k = keys[i]
-        deps, overflow = set_insert(deps, lw[p, k], enable, overflow)
+        deps, overflow = set_insert(deps, lw[p, k], en, overflow)
         if not nfr:
             # writes also depend on the latest read (keys/mod.rs:66-70)
             deps, overflow = set_insert(
-                deps, jnp.where(read_only, 0, lr[p, k]), enable, overflow
+                deps, jnp.where(read_only, 0, lr[p, k]), en, overflow
             )
         new_latest = dot + 1
         lw = lw.at[p, k].set(
-            jnp.where(enable & ~read_only, new_latest, lw[p, k])
+            jnp.where(en & ~read_only, new_latest, lw[p, k])
         )
-        lr = lr.at[p, k].set(jnp.where(enable & read_only, new_latest, lr[p, k]))
+        lr = lr.at[p, k].set(jnp.where(en & read_only, new_latest, lr[p, k]))
     return kd._replace(latest_w=lw, latest_r=lr), deps, overflow
 
 

@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from ...core.ids import dot_proc
+
 
 def key_shard(key, shards: int):
     """Shard owning `key` (traceable)."""
@@ -30,3 +32,16 @@ def shard_touch(ctx, dot, shards: int):
     """[shards] bool: shards the command has a key in."""
     ks = key_shard(ctx.cmds.keys[dot], shards)
     return jnp.stack([(ks == t).any() for t in range(shards)])
+
+
+def own_coord(ctx, dot, shards: int):
+    """bool: the dot's coordinator belongs to the handling process's shard.
+
+    GC only tracks own-shard dots (`atlas.rs:461-466` checks
+    `shard_processes.contains(&dot.source())` before notifying `MCommitDot`):
+    a shard commits every dot its members coordinate, so own-shard frontiers
+    stay contiguous, while remote-coordinator dots would leave holes."""
+    if shards == 1:
+        return jnp.bool_(True)
+    coord = dot_proc(dot, ctx.spec.max_seq)
+    return ctx.env.shard_of[coord] == ctx.env.shard_of[ctx.pid]

@@ -13,5 +13,7 @@ from ..engine.types import ProtocolDef
 from .atlas import _make
 
 
-def make_protocol(n: int, keys_per_command: int = 1, nfr: bool = False) -> ProtocolDef:
-    return _make("epaxos", n, keys_per_command, nfr)
+def make_protocol(
+    n: int, keys_per_command: int = 1, nfr: bool = False, shards: int = 1
+) -> ProtocolDef:
+    return _make("epaxos", n, keys_per_command, nfr, shards)

@@ -257,7 +257,11 @@ def make_protocol(
                 )
             ),
             commit_count=st.commit_count.at[p].add(enable.astype(jnp.int32)),
-            gc=gc_mod.gc_commit(st.gc, p, dot, enable, ctx.spec.max_seq),
+            gc=gc_mod.gc_commit(
+                st.gc, p, dot,
+                enable & sharding.own_coord(ctx, dot, shards),
+                ctx.spec.max_seq,
+            ),
         )
         # attached votes -> executor, one row per key slot
         info_rows = []

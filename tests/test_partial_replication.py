@@ -22,16 +22,21 @@ from fantoch_tpu.protocols import tempo as tempo_proto
 CMDS = 20
 
 
-def run_shards(shards, kpc, conflict, clients_per_region=1):
+def run_proto_shards(
+    proto_mod, shards, kpc, conflict, cmds=CMDS, clients_per_region=1,
+    **config_kw,
+):
+    """Shared drive: build one protocol instance over `shards` shards and run
+    the standard two-region client placement through the event engine."""
     planet = Planet.new()
-    config = Config(n=3, f=1, shard_count=shards, gc_interval_ms=100)
+    config = Config(n=3, f=1, shard_count=shards, gc_interval_ms=100, **config_kw)
     wl = Workload(
         shard_count=shards,
         key_gen=KeyGen.conflict_pool(conflict_rate=conflict, pool_size=2),
         keys_per_command=kpc,
-        commands_per_client=CMDS,
+        commands_per_client=cmds,
     )
-    pdef = basic_proto.make_protocol(
+    pdef = proto_mod.make_protocol(
         config.n * shards, wl.keys_per_command, shards=shards
     )
     client_regions = ["us-west1", "us-west2"]
@@ -49,6 +54,13 @@ def run_shards(shards, kpc, conflict, clients_per_region=1):
     st = jax.tree_util.tree_map(np.asarray, st)
     summary.check_sim_health(st)
     return st, env, spec
+
+
+def run_shards(shards, kpc, conflict, clients_per_region=1):
+    return run_proto_shards(
+        basic_proto, shards, kpc, conflict,
+        clients_per_region=clients_per_region,
+    )
 
 
 def test_two_shards_single_key_commands_complete():
@@ -71,6 +83,7 @@ def test_two_shards_spanning_commands_complete():
     st, env, spec = run_shards(shards=2, kpc=2, conflict=50)
     assert int(st.c_done.sum()) == st.c_done.shape[0]
     np.testing.assert_array_equal(st.lat_cnt, CMDS)
+    check_shard_stable(st, spec)
     # every commit on a shard executed only that shard's keys: each command
     # yields exactly kpc=2 partial results in total (AggregatePending)
     # which is what completed the clients above; commits happened on both
@@ -105,30 +118,7 @@ def test_mismatched_shard_instance_rejected():
 
 
 def run_tempo_shards(shards, kpc, conflict, cmds=15):
-    planet = Planet.new()
-    config = Config(n=3, f=1, shard_count=shards, gc_interval_ms=100)
-    wl = Workload(
-        shard_count=shards,
-        key_gen=KeyGen.conflict_pool(conflict_rate=conflict, pool_size=2),
-        keys_per_command=kpc,
-        commands_per_client=cmds,
-    )
-    pdef = tempo_proto.make_protocol(
-        config.n * shards, wl.keys_per_command, shards=shards
-    )
-    client_regions = ["us-west1", "us-west2"]
-    spec = setup.build_spec(
-        config, wl, pdef, n_clients=2, n_client_groups=2,
-        extra_ms=1000, max_steps=5_000_000,
-    )
-    placement = setup.Placement(
-        ["asia-east1", "us-central1", "us-west1"], client_regions, 1
-    )
-    env = setup.build_env(spec, config, planet, placement, wl, pdef)
-    st = jax.jit(lockstep.make_run(spec, pdef, wl))(env)
-    st = jax.tree_util.tree_map(np.asarray, st)
-    summary.check_sim_health(st)
-    return st, env, spec
+    return run_proto_shards(tempo_proto, shards, kpc, conflict, cmds=cmds)
 
 
 def test_tempo_two_shards_single_key_commands():
@@ -145,6 +135,7 @@ def test_tempo_two_shards_spanning_commands():
     st, env, spec = run_tempo_shards(shards=2, kpc=2, conflict=50)
     assert int(st.c_done.sum()) == 2
     np.testing.assert_array_equal(st.lat_cnt, 15)
+    check_shard_stable(st, spec)
     commits = np.asarray(st.proto.commit_count)
     assert (commits[:3] > 0).all() and (commits[3:] > 0).all(), commits
 
@@ -153,5 +144,101 @@ def test_tempo_single_shard_goldens_unchanged():
     st, env, spec = run_tempo_shards(shards=1, kpc=1, conflict=100)
     assert int(st.c_done.sum()) == 2
     # n=3 f=1 always takes the fast path (protocol/mod.rs expectations)
+    assert int(np.asarray(st.proto.slow_count).sum()) == 0
+    assert int(np.asarray(st.proto.fast_count).sum()) > 0
+
+
+def run_graph_shards(proto_mod, shards, kpc, conflict, cmds=15):
+    """Atlas/EPaxos under partial replication: MForwardSubmit + shard dep-set
+    union (MShardCommit/MShardAggregatedCommit) + the graph executor's
+    cross-shard dependency requests (executor/graph/mod.rs:34-43)."""
+    return run_proto_shards(
+        proto_mod, shards, kpc, conflict, cmds=cmds,
+        executor_executed_notification_interval_ms=10,
+    )
+
+
+def check_shard_stable(st, spec):
+    """GC completeness under partial replication: every member of a shard
+    eventually sees every dot coordinated by that shard as stable
+    (the per-shard analogue of `stable == commands`,
+    `fantoch_ps/src/protocol/mod.rs:929-940`; GC tracks own-shard dots only,
+    `atlas.rs:461-466`)."""
+    n, shards = spec.n, spec.shards
+    ranks = n // shards
+    used = np.asarray(st.next_seq) - 1
+    stable = np.asarray(st.proto.gc.stable_count)
+    for s in range(shards):
+        coordinated = used[s * ranks : (s + 1) * ranks].sum()
+        np.testing.assert_array_equal(
+            stable[s * ranks : (s + 1) * ranks], coordinated,
+            err_msg=f"shard {s} stable != coordinated dots",
+        )
+
+
+def check_shard_order_agreement(st, spec):
+    """Cross-replica execution-order oracle (ExecutionOrderMonitor,
+    `fantoch_ps/src/protocol/mod.rs:787-871`) scoped to partial replication:
+    every key is applied only by its owner shard, and all replicas of that
+    shard must apply it in the same order."""
+    n, shards = spec.n, spec.shards
+    ranks = n // shards
+    oh = np.asarray(st.exec.order_hash)
+    oc = np.asarray(st.exec.order_cnt)
+    K = oh.shape[1]
+    keys = np.arange(K)
+    for s in range(shards):
+        members = range(s * ranks, (s + 1) * ranks)
+        owned = keys % shards == s
+        for m in members:
+            np.testing.assert_array_equal(
+                oh[m][owned], oh[s * ranks][owned],
+                err_msg=f"shard {s} order divergence at process {m}",
+            )
+        # non-owned keys were never applied here
+        for m in members:
+            assert (oc[m][~owned] == 0).all()
+
+
+def test_atlas_two_shards_single_key_commands():
+    from fantoch_tpu.protocols import atlas as atlas_proto
+
+    st, env, spec = run_graph_shards(atlas_proto, shards=2, kpc=1, conflict=50)
+    assert int(st.c_done.sum()) == 2
+    np.testing.assert_array_equal(st.lat_cnt, 15)
+    used = st.next_seq - 1
+    assert used[:3].sum() > 0 and used[3:].sum() > 0, used
+    check_shard_order_agreement(st, spec)
+
+
+def test_atlas_two_shards_spanning_commands():
+    from fantoch_tpu.protocols import atlas as atlas_proto
+
+    st, env, spec = run_graph_shards(atlas_proto, shards=2, kpc=2, conflict=50)
+    assert int(st.c_done.sum()) == 2
+    np.testing.assert_array_equal(st.lat_cnt, 15)
+    commits = np.asarray(st.proto.commit_count)
+    assert (commits[:3] > 0).all() and (commits[3:] > 0).all(), commits
+    check_shard_order_agreement(st, spec)
+    check_shard_stable(st, spec)
+    # spanning commands create cross-shard dependencies: the executors must
+    # have fetched remote vertices to order through them
+    assert int(np.asarray(st.exec.requested).sum()) > 0
+
+
+def test_epaxos_two_shards_spanning_commands():
+    from fantoch_tpu.protocols import epaxos as epaxos_proto
+
+    st, env, spec = run_graph_shards(epaxos_proto, shards=2, kpc=2, conflict=50)
+    assert int(st.c_done.sum()) == 2
+    np.testing.assert_array_equal(st.lat_cnt, 15)
+    check_shard_order_agreement(st, spec)
+
+
+def test_atlas_single_shard_unchanged_by_shard_plumbing():
+    from fantoch_tpu.protocols import atlas as atlas_proto
+
+    st, env, spec = run_graph_shards(atlas_proto, shards=1, kpc=1, conflict=100)
+    assert int(st.c_done.sum()) == 2
     assert int(np.asarray(st.proto.slow_count).sum()) == 0
     assert int(np.asarray(st.proto.fast_count).sum()) > 0
