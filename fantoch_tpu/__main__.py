@@ -28,6 +28,7 @@ def _icsv(s: str):
 def cmd_sim(args) -> int:
     from .exp.harness import Point, run_grid
     from .plot.db import ResultsDB
+    from .engine.summary import metric_summaries
     from .plot.plots import sim_output_stats
 
     if args.batch > 1:
@@ -63,7 +64,12 @@ def cmd_sim(args) -> int:
     )
     db = ResultsDB.load(args.results)
     # print only this invocation's run (the root may hold older results)
-    for stats in sim_output_stats(db.find(**pt.search())):
+    for entry, stats in zip(
+        db.find(**pt.search()), sim_output_stats(db.find(**pt.search()))
+    ):
+        # collected-metric stats alongside the latency summary, like the
+        # reference sweep's metric printout (bin/simulation.rs:580-600)
+        stats["metrics"] = metric_summaries(entry.metrics)
         print(json.dumps(stats))
     print(f"results: {dirs[0]}", file=sys.stderr)
     return 0
@@ -115,7 +121,9 @@ def cmd_plot(args) -> int:
     from .plot.db import ResultsDB
     from .plot.plots import (
         cdf_plot,
+        dstat_table,
         fast_path_plot,
+        nfr_plot,
         sim_output_stats,
         throughput_latency_plot,
     )
@@ -139,6 +147,16 @@ def cmd_plot(args) -> int:
                 series, "conflict", os.path.join(args.out, "fast_path.png")
             )
         )
+    ro_values = {
+        e.search["read_only_percentage"]
+        for e in db
+        if "read_only_percentage" in e.search
+    }
+    if len(ro_values) > 1:
+        made.append(nfr_plot(series, os.path.join(args.out, "nfr.png")))
+    table = dstat_table(args.results)
+    if len(table.splitlines()) > 1:
+        print(table, file=sys.stderr)
     for stats in sim_output_stats(list(db)):
         print(json.dumps(stats))
     print(json.dumps({"figures": made}))

@@ -60,3 +60,41 @@ def protocol_metrics(st: SimState, pdef: ProtocolDef) -> Dict[str, np.ndarray]:
     if pdef.metrics is None:
         return {}
     return {k: np.asarray(v) for k, v in pdef.metrics(st.proto).items()}
+
+
+def executor_metrics(st: SimState, pdef: ProtocolDef) -> Dict[str, np.ndarray]:
+    """Per-process executor metrics (`ExecutorMetrics`,
+    `fantoch/src/executor/mod.rs:123-130`)."""
+    if pdef.executor.metrics is None:
+        return {}
+    return {k: np.asarray(v) for k, v in pdef.executor.metrics(st.exec).items()}
+
+
+def hist_stats(row: np.ndarray) -> Dict[str, float]:
+    """Summary stats of one process's bucketed metric histogram row
+    (protocols/common/mhist.py layout: bucket i counts value i)."""
+    h = Histogram.from_buckets(row)
+    if not h.count():
+        return {"count": 0}
+    return {
+        "count": h.count(),
+        "avg": round(h.mean(), 3),
+        "p95": h.percentile(0.95),
+        "p99": h.percentile(0.99),
+        "max": max(h.values),
+    }
+
+
+def metric_summaries(metrics: Dict[str, np.ndarray]) -> Dict[str, object]:
+    """Collapse a metrics dict for reporting: "*_hist" [n, B] entries become
+    whole-system histogram stats (all processes merged); everything else
+    passes through as per-process lists."""
+    out: Dict[str, object] = {}
+    for k, v in metrics.items():
+        v = np.asarray(v)
+        if k.endswith("_hist") and v.ndim >= 2:
+            merged = v.reshape(-1, v.shape[-1]).sum(axis=0)
+            out[k[: -len("_hist")]] = hist_stats(merged)
+        else:
+            out[k] = v.tolist()
+    return out

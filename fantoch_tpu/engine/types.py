@@ -156,6 +156,10 @@ class ExecutorDef:
     # optional committed/executed frontier notification (Executor::executed)
     executed_width: int = 0
     executed: Optional[Callable[..., Any]] = None  # (ctx, estate, p) -> (estate, info [executed_width])
+    # executor-metric extraction from final state -> dict of arrays
+    # (ExecutorMetrics, fantoch/src/executor/mod.rs:123-130); keys ending in
+    # "_hist" are [n, B] bucketed histograms (protocols/common/mhist.py)
+    metrics: Optional[Callable[..., dict]] = None
 
 
 @dataclasses.dataclass(frozen=True)

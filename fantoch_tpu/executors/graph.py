@@ -50,6 +50,7 @@ import jax.numpy as jnp
 
 from ..engine.types import ExecutorDef
 from ..ops.closure import transitive_closure
+from ..protocols.common.mhist import hist_add, hist_init
 from ..protocols.common.sharding import key_shard
 from .ready import ReadyRing, ready_capacity, ready_drain, ready_init, ready_push, writer_id
 
@@ -57,6 +58,9 @@ ORDER_HASH_MULT = jnp.int32(0x01000193)
 
 # missing-dep request slots surfaced per executed-notification tick
 MAX_REQS = 8
+
+# ChainSize histogram buckets (SCC sizes; last bucket = tail)
+CHAIN_BUCKETS = 128
 
 
 class GraphExecState(NamedTuple):
@@ -67,8 +71,11 @@ class GraphExecState(NamedTuple):
     order_hash: jnp.ndarray  # [n, K] int32
     order_cnt: jnp.ndarray  # [n, K] int32
     executed_count: jnp.ndarray  # [n] int32 commands executed
-    chain_max: jnp.ndarray  # [n] int32 largest ready batch (ChainSize metric)
+    chain_max: jnp.ndarray  # [n] int32 largest ready batch
     requested: jnp.ndarray  # [n, DOTS] bool cross-shard dep request sent
+    recv_ms: jnp.ndarray  # [n, DOTS] int32 vertex-creation time
+    chain_hist: jnp.ndarray  # [n, CB] ChainSize: committed SCC sizes (graph/mod.rs:493)
+    delay_hist: jnp.ndarray  # [n, HB] ExecutionDelay: commit->execute ms (graph/mod.rs:518)
     ready: ReadyRing
 
 
@@ -88,10 +95,13 @@ def make_executor(n: int, max_deps: int, shards: int = 1) -> ExecutorDef:
             executed_count=jnp.zeros((n,), jnp.int32),
             chain_max=jnp.zeros((n,), jnp.int32),
             requested=jnp.zeros((n, DOTS), jnp.bool_),
+            recv_ms=jnp.zeros((n, DOTS), jnp.int32),
+            chain_hist=hist_init(n, CHAIN_BUCKETS),
+            delay_hist=hist_init(n, spec.hist_buckets),
             ready=ready_init(n, ready_capacity(spec)),
         )
 
-    def _try_execute(ctx, est: GraphExecState, p):
+    def _try_execute(ctx, est: GraphExecState, p, now):
         DOTS = est.committed.shape[1]
         KPC = ctx.spec.keys_per_command
         dots = jnp.arange(DOTS, dtype=jnp.int32)
@@ -121,6 +131,19 @@ def make_executor(n: int, max_deps: int, shards: int = 1) -> ExecutorDef:
         Rs = R | jnp.eye(DOTS, dtype=jnp.bool_)
         rank = (Rs & U[None, :]).sum(axis=1)
         est = est._replace(chain_max=est.chain_max.at[p].max(U.sum()))
+
+        # ChainSize: one entry per ready SCC (scc.len(), graph/mod.rs:493) —
+        # SCC(d) = mutual-reach peers of d within U; counted once at the
+        # dot-minimal member
+        mutual = R & R.T
+        peers = mutual & U[None, :] & (dots[None, :] != dots[:, None])
+        scc_size = peers.sum(axis=1) + 1
+        rep = U & ~(peers & (dots[None, :] < dots[:, None])).any(axis=1)
+        est = est._replace(
+            chain_hist=est.chain_hist.at[
+                p, jnp.clip(scc_size, 0, CHAIN_BUCKETS - 1)
+            ].add(rep.astype(jnp.int32))
+        )
 
         def cond(carry):
             e, u = carry
@@ -159,6 +182,10 @@ def make_executor(n: int, max_deps: int, shards: int = 1) -> ExecutorDef:
                 ready=ready,
                 executed=e.executed.at[p, d].set(True),
                 executed_count=e.executed_count.at[p].add(1),
+                # ExecutionDelay: vertex creation -> execution (graph/mod.rs:518)
+                delay_hist=hist_add(
+                    e.delay_hist, p, now - e.recv_ms[p, d], True
+                ),
             )
             return e, u.at[d].set(False)
 
@@ -170,8 +197,11 @@ def make_executor(n: int, max_deps: int, shards: int = 1) -> ExecutorDef:
         est = est._replace(
             committed=est.committed.at[p, dot].set(True),
             deps=est.deps.at[p, dot].set(info[1 : 1 + D]),
+            recv_ms=est.recv_ms.at[p, dot].set(
+                jnp.where(est.committed[p, dot], est.recv_ms[p, dot], now)
+            ),
         )
-        return _try_execute(ctx, est, p)
+        return _try_execute(ctx, est, p, now)
 
     def drain(ctx, est: GraphExecState, p):
         ready, res = ready_drain(est.ready, p, ctx.spec.max_res)
@@ -212,6 +242,14 @@ def make_executor(n: int, max_deps: int, shards: int = 1) -> ExecutorDef:
         est = est._replace(requested=est.requested.at[p].set(est.requested[p] | take))
         return est, row
 
+    def metrics(est: GraphExecState):
+        return {
+            "chain_size_hist": est.chain_hist,
+            "execution_delay_hist": est.delay_hist,
+            # OutRequests aggregate (graph/mod.rs:553)
+            "out_requests": est.requested.sum(axis=1),
+        }
+
     return ExecutorDef(
         name="graph",
         exec_width=EW,
@@ -220,4 +258,5 @@ def make_executor(n: int, max_deps: int, shards: int = 1) -> ExecutorDef:
         drain=drain,
         executed_width=MAX_REQS if shards > 1 else 0,
         executed=executed if shards > 1 else None,
+        metrics=metrics,
     )

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from ..engine.types import ExecutorDef
 from ..ops.pred_ready import pred_ready
 from ..protocols.common.bitmap import bm_pack, bm_words
+from ..protocols.common.mhist import hist_add, hist_init
 from .ready import ReadyRing, ready_capacity, ready_drain, ready_init, ready_push, writer_id
 
 ORDER_HASH_MULT = jnp.int32(0x01000193)
@@ -45,6 +46,8 @@ class PredExecState(NamedTuple):
     order_cnt: jnp.ndarray  # [n, K] int32
     executed_count: jnp.ndarray  # [n] int32
     chain_max: jnp.ndarray  # [n] int32 largest ready batch per call
+    recv_ms: jnp.ndarray  # [n, DOTS] int32 commit-receipt time
+    delay_hist: jnp.ndarray  # [n, HB] ExecutionDelay (pred/mod.rs:360)
     ready: ReadyRing
 
 
@@ -67,6 +70,8 @@ def make_executor(n: int, max_seq: int) -> ExecutorDef:
             order_cnt=jnp.zeros((n, spec.key_space), jnp.int32),
             executed_count=jnp.zeros((n,), jnp.int32),
             chain_max=jnp.zeros((n,), jnp.int32),
+            recv_ms=jnp.zeros((n, DOTS), jnp.int32),
+            delay_hist=hist_init(n, spec.hist_buckets),
             ready=ready_init(n, ready_capacity(spec)),
         )
 
@@ -75,7 +80,7 @@ def make_executor(n: int, max_seq: int) -> ExecutorDef:
         ops/pred_ready.py: Pallas on TPU, XLA composition elsewhere)."""
         return pred_ready(est.deps[p], est.committed[p], est.executed[p], est.clock[p])
 
-    def _try_execute(ctx, est: PredExecState, p):
+    def _try_execute(ctx, est: PredExecState, p, now):
         KPC = ctx.spec.keys_per_command
         dots = jnp.arange(DOTS, dtype=jnp.int32)
         est = est._replace(chain_max=est.chain_max.at[p].max(_ready_set(est, p).sum()))
@@ -105,6 +110,8 @@ def make_executor(n: int, max_seq: int) -> ExecutorDef:
                 ready=ring,
                 executed=e.executed.at[p, d].set(True),
                 executed_count=e.executed_count.at[p].add(1),
+                # ExecutionDelay: commit receipt -> execution (pred/mod.rs:360)
+                delay_hist=hist_add(e.delay_hist, p, now - e.recv_ms[p, d], True),
             )
 
         return jax.lax.while_loop(cond, body, est)
@@ -115,8 +122,11 @@ def make_executor(n: int, max_seq: int) -> ExecutorDef:
             committed=est.committed.at[p, dot].set(True),
             clock=est.clock.at[p, dot].set(info[1]),
             deps=est.deps.at[p, dot].set(info[2 : 2 + BW]),
+            recv_ms=est.recv_ms.at[p, dot].set(
+                jnp.where(est.committed[p, dot], est.recv_ms[p, dot], now)
+            ),
         )
-        return _try_execute(ctx, est, p)
+        return _try_execute(ctx, est, p, now)
 
     def drain(ctx, est: PredExecState, p):
         ring, res = ready_drain(est.ready, p, ctx.spec.max_res)
@@ -127,6 +137,9 @@ def make_executor(n: int, max_seq: int) -> ExecutorDef:
         (idempotent analogue of the reference's drained `new_executed_dots`)."""
         return est, bm_pack(est.executed[p], BW)
 
+    def metrics(est: PredExecState):
+        return {"execution_delay_hist": est.delay_hist}
+
     return ExecutorDef(
         name="pred",
         exec_width=EW,
@@ -135,4 +148,5 @@ def make_executor(n: int, max_seq: int) -> ExecutorDef:
         drain=drain,
         executed_width=BW,
         executed=executed,
+        metrics=metrics,
     )

@@ -16,10 +16,34 @@ conflict rate, read-only %, placement and seed vary freely as Env data.
 from __future__ import annotations
 
 import dataclasses
+import resource
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
+
+
+def _dstat_sample(wall_s: float, st) -> Dict[str, float]:
+    """Host/device resource snapshot for one sweep bucket — the harness's
+    stand-in for the reference's per-machine dstat collection
+    (`fantoch_exp/src/bench.rs:773-812`; tabulated by `plot.plots.dstat_table`)."""
+    rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    events = float(np.asarray(st.step).sum())
+    sample = {
+        "wall_s": round(wall_s, 3),
+        "events_per_sec": round(events / max(wall_s, 1e-9), 1),
+        "peak_rss_mb": round(rss_kb / 1024.0, 1),
+    }
+    try:
+        stats = jax.devices()[0].memory_stats()
+        if stats and "peak_bytes_in_use" in stats:
+            sample["device_mem_mb"] = round(
+                stats["peak_bytes_in_use"] / (1024.0 * 1024.0), 1
+            )
+    except Exception:
+        pass
+    return sample
 
 from ..core.config import Config
 from ..core.planet import Planet
@@ -212,6 +236,7 @@ def run_grid(
                 )
             batched = sweep.shard_envs(batched, mesh)
 
+        t0 = time.perf_counter()
         if chunk_steps:
             init, chunk, done = sweep.make_chunked_runner(spec, pdef, wl, chunk_steps)
             st = init(batched)
@@ -224,16 +249,25 @@ def run_grid(
                     )
         else:
             st = sweep.run_batch(spec, pdef, wl, batched)
+        jax.block_until_ready(st)
+        wall_s = time.perf_counter() - t0
         st = jax.tree_util.tree_map(np.asarray, st)
         B = len(envs)
         st = jax.tree_util.tree_map(lambda x: x[:B], st)  # drop mesh padding
+        # sample after dropping mesh padding so events/sec counts only the
+        # bucket's real configs
+        dstat = _dstat_sample(wall_s, st)
         summary.check_sim_health(st)
 
-        metrics = {}
-        if pdef.metrics is not None:
-            metrics = {
-                k: np.asarray(v) for k, v in pdef.metrics(st.proto).items()
+        # executor metrics ride the same store, namespaced like the
+        # reference's separate ExecutorMetrics (executor/mod.rs:123-130)
+        metrics = dict(summary.protocol_metrics(st, pdef))
+        metrics.update(
+            {
+                f"executor_{k}": v
+                for k, v in summary.executor_metrics(st, pdef).items()
             }
+        )
         out_dirs.append(
             results_db.save_sweep(
                 results_root,
@@ -248,7 +282,7 @@ def run_grid(
                 steps=np.asarray(st.step),
                 client_regions=client_regions,
                 metrics=metrics,
-                extra_meta={"process_regions": list(pregions)},
+                extra_meta={"process_regions": list(pregions), "dstat": dstat},
             )
         )
         if verbose:
@@ -280,6 +314,7 @@ def replay_graph_stream(rows: Sequence[Sequence[int]], n: int = 1) -> dict:
         n_clients=1,
         commands_per_client=dots,
         max_res=4,
+        hist_buckets=64,
     )
     exdef = graph_executor.make_executor(n, D)
     estate = exdef.init(spec, None)

@@ -9,10 +9,16 @@ matplotlib — same families:
 - `throughput_latency_plot` — latency vs throughput curves per protocol
   (`throughput_something_plot`)
 - `fast_path_plot`      — fast-path rate vs an x key (`fast_path_plot`)
-- `latency_bar_plot`    — per-region mean latency bars (`nfr_plot` shape)
+- `latency_bar_plot`    — per-region mean latency bars
+- `nfr_plot`            — latency bars grouped by read-only percentage
+  (`nfr_plot`, lib.rs:282)
+- `recovery_plot`       — latency timelines around a failure, per site
+  (`recovery_plot`, lib.rs:185)
 - `heatmap_plot`        — metric over a 2-D config grid (`heatmap_plot`)
-- `metrics_table`       — text table of per-process metrics
-  (`process_metrics_table` / `dstat_table`)
+- `batching_plot`       — throughput/latency vs batch size (`batching_plot`)
+- `metrics_table`       — text table of per-process protocol/executor
+  metrics (`process_metrics_table`)
+- `dstat_table`         — harness resource samples per sweep (`dstat_table`)
 - `sim_output_stats`    — avg/p95/p99/p99.9 + fast-path summary per entry
   (`bin/plot_sim_output.rs`)
 
@@ -205,13 +211,133 @@ def metrics_table(
     entries: Sequence[ExperimentData],
     label_keys: Optional[Sequence[str]] = None,
 ) -> str:
-    """Text table of per-process protocol metrics (`process_metrics_table`)."""
+    """Text table of per-process protocol/executor metrics
+    (`process_metrics_table`). Collected histogram metrics ("*_hist") print
+    as count/avg/p95/p99/max summaries like the reference's metric rows."""
+    from ..engine.summary import hist_stats
+
     lines = []
     for e in entries:
         lines.append(_label(e, label_keys))
         for name, arr in sorted(e.metrics.items()):
-            vals = " ".join(f"{int(v):>8}" for v in np.asarray(arr).ravel())
-            lines.append(f"  {name:<10} {vals}")
+            arr = np.asarray(arr)
+            if name.endswith("_hist") and arr.ndim >= 2:
+                s = hist_stats(arr.reshape(-1, arr.shape[-1]).sum(axis=0))
+                vals = " ".join(f"{k}={v}" for k, v in s.items())
+                lines.append(f"  {name:<28} {vals}")
+            else:
+                vals = " ".join(f"{int(v):>8}" for v in arr.ravel())
+                lines.append(f"  {name:<28} {vals}")
+    return "\n".join(lines)
+
+
+def nfr_plot(
+    series: Dict[str, Sequence[ExperimentData]],
+    output: str,
+    x_key: str = "read_only_percentage",
+    stat: str = "avg",
+) -> str:
+    """Grouped latency bars by read-only percentage, one bar per protocol
+    variant (`nfr_plot`, `fantoch_plot/src/lib.rs:282` — the NFR evaluation
+    figure comparing read latency with/without non-fault-tolerant reads)."""
+    # entries from sweeps that never recorded x_key are skipped, not fatal
+    series = {
+        name: [e for e in es if x_key in e.search]
+        for name, es in series.items()
+    }
+    xs_all = sorted({e.search[x_key] for es in series.values() for e in es})
+    width = 0.8 / max(len(series), 1)
+    fig, ax = plt.subplots(figsize=(6, 4))
+    xpos = np.arange(len(xs_all), dtype=float)
+    for i, (name, entries) in enumerate(series.items()):
+        ys = []
+        for x in xs_all:
+            hit = [e for e in entries if e.search[x_key] == x]
+            if not hit:
+                ys.append(0.0)
+            elif stat == "avg":
+                ys.append(hit[0].global_latency.mean())
+            else:
+                ys.append(
+                    hit[0].global_latency.percentile(
+                        {"p95": 0.95, "p99": 0.99}[stat]
+                    )
+                )
+        ax.bar(xpos + i * width, ys, width, label=name)
+    ax.set_xticks(xpos + 0.4 - width / 2)
+    ax.set_xticklabels([f"{x}%" for x in xs_all])
+    ax.set_xlabel("read-only commands")
+    ax.set_ylabel(f"{stat} latency (ms)")
+    ax.legend(fontsize=7)
+    ax.grid(alpha=0.3, axis="y")
+    fig.savefig(output, bbox_inches="tight", dpi=150)
+    plt.close(fig)
+    return output
+
+
+def recovery_plot(
+    sites: Dict[str, Dict[str, Sequence[float]]],
+    output: str,
+    x_label: str = "time (s)",
+    y_label: str = "latency (ms)",
+) -> str:
+    """Latency-timeline subplots around a failure, one subplot per site and
+    one line per protocol (`recovery_plot`, `fantoch_plot/src/lib.rs:185` —
+    the reference renders it from externally collected timeline data, e.g.
+    its `eurosys20_data/recovery` files; the data rows come in the same
+    site -> protocol -> per-second-latency shape here)."""
+    ncols = 2
+    nrows = (len(sites) + ncols - 1) // ncols
+    fig, axes = plt.subplots(
+        nrows, ncols, figsize=(8, 3 * nrows), squeeze=False
+    )
+    fig.subplots_adjust(hspace=0.5, wspace=0.2)
+    for i, (site, protos) in enumerate(sites.items()):
+        ax = axes[i // ncols][i % ncols]
+        ax.set_title(site, fontsize=9)
+        for name, ys in protos.items():
+            ax.plot(np.arange(1, len(ys) + 1), ys, label=name, linewidth=1)
+        ax.set_xlabel(x_label, fontsize=8)
+        ax.set_ylabel(y_label, fontsize=8)
+        ax.grid(alpha=0.3)
+        ax.legend(fontsize=7)
+    for j in range(len(sites), nrows * ncols):
+        axes[j // ncols][j % ncols].axis("off")
+    fig.savefig(output, bbox_inches="tight", dpi=150)
+    plt.close(fig)
+    return output
+
+
+def dstat_table(results_root: str) -> str:
+    """Text table of the per-sweep host/device resource samples collected by
+    the experiment harness (`dstat_table`, `fantoch_plot/src/lib.rs:2294` —
+    the reference tabulates dstat cpu/mem/net collected on every machine;
+    here the harness records wall time, throughput, peak RSS and device
+    memory per sweep bucket in each results dir's meta.json)."""
+    import json as _json
+    import os as _os
+
+    header = (
+        f"{'sweep':<40} {'wall_s':>8} {'events/s':>12} "
+        f"{'peak_rss_mb':>12} {'device_mem_mb':>14}"
+    )
+    lines = [header]
+    if not _os.path.isdir(results_root):
+        return header
+    for d in sorted(_os.listdir(results_root)):
+        meta_path = _os.path.join(results_root, d, "meta.json")
+        if not _os.path.isfile(meta_path):
+            continue
+        with open(meta_path) as f:
+            meta = _json.load(f)
+        ds = meta.get("dstat")
+        if not ds:
+            continue
+        lines.append(
+            f"{d:<40} {ds['wall_s']:>8.2f} {ds['events_per_sec']:>12,.0f} "
+            f"{ds['peak_rss_mb']:>12.1f} "
+            f"{ds.get('device_mem_mb', float('nan')):>14.1f}"
+        )
     return "\n".join(lines)
 
 

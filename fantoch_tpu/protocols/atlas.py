@@ -102,6 +102,8 @@ class AtlasState(NamedTuple):
     # dep requests that arrived before this dot committed locally
     # (buffered_in_requests, executor/graph/mod.rs:64): requester bitmask
     reqpend: jnp.ndarray  # [n, DOTS] int32
+    in_requests: jnp.ndarray  # [n] int32 dep requests served (InRequests,
+    # executor/graph/mod.rs:293 — served by the protocol here)
 
 
 def _make(
@@ -140,9 +142,13 @@ def _make(
             fast_count=z(n),
             slow_count=z(n),
             commit_count=z(n),
-            sc_cnt=z(n, DOTS) if multi else z(1, 1),
-            sc_deps=z(n, DOTS, D) if multi else z(1, 1, 1),
-            reqpend=z(n, DOTS) if multi else z(1, 1),
+            # single-shard builds carry [n, 1]-shaped dummies: every state
+            # leaf keeps the process leading axis (the distributed runner
+            # shards all leaves over it)
+            sc_cnt=z(n, DOTS) if multi else z(n, 1),
+            sc_deps=z(n, DOTS, D) if multi else z(n, 1, 1),
+            reqpend=z(n, DOTS) if multi else z(n, 1),
+            in_requests=z(n),
         )
 
     def _add_cmd(ctx, st: AtlasState, p, dot, past, enable):
@@ -415,6 +421,10 @@ def _make(
         atlas.rs add_shards_commits_info extending the dep set)."""
         dot = payload[0]
         rdeps = payload[1 : 1 + D]
+        # capacity: the union of all shards' sets fits one D-row because each
+        # shard contributes deps only for keys it owns (slot_en in add_cmd),
+        # so across shards the per-key contributions are disjoint and the
+        # total is bounded by sum over keys of 2*(ranks+1) <= D
         row = st.sc_deps[p, dot]
         overflow = st.dep_overflow
         for j in range(D):
@@ -467,7 +477,8 @@ def _make(
                     committed, st.reqpend[p, dot],
                     st.reqpend[p, dot] | (jnp.int32(1) << src),
                 )
-            )
+            ),
+            in_requests=st.in_requests.at[p].add(1),
         )
         return st, ob, empty_execout(MAX_EXEC, EW)
 
@@ -526,6 +537,7 @@ def _make(
             "commits": st.commit_count,
             "fast": st.fast_count,
             "slow": st.slow_count,
+            "in_requests": st.in_requests,
         }
 
     def quorum_sizes(cfg):

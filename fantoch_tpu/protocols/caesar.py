@@ -64,6 +64,9 @@ from ..engine.types import (
 )
 from ..executors import pred as pred_executor
 from .common.bitmap import bm_clear, bm_count, bm_get, bm_pack, bm_unpack, bm_words
+from .common.mhist import hist_add, hist_init
+
+DEPS_LEN_BUCKETS = 128  # CommittedDepsLen histogram width (last bucket = tail)
 
 MPROPOSE = 0
 MPROPOSEACK = 1
@@ -119,6 +122,12 @@ class CaesarState(NamedTuple):
     fast_count: jnp.ndarray  # [n] int32
     slow_count: jnp.ndarray  # [n] int32
     commit_count: jnp.ndarray  # [n] int32
+    # collected metric histograms (caesar.rs:645-670, 1055-1070)
+    start_ms: jnp.ndarray  # [n, DOTS] int32 MPropose-receipt time
+    wait_start_ms: jnp.ndarray  # [n, DOTS] int32 wait-condition entry time
+    commit_lat_hist: jnp.ndarray  # [n, HB] CommitLatency
+    deps_len_hist: jnp.ndarray  # [n, DB] CommittedDepsLen
+    wait_delay_hist: jnp.ndarray  # [n, HB] WaitConditionDelay
 
 
 def make_protocol(
@@ -179,6 +188,11 @@ def make_protocol(
             fast_count=z(n),
             slow_count=z(n),
             commit_count=z(n),
+            start_ms=z(n, DOTS),
+            wait_start_ms=z(n, DOTS),
+            commit_lat_hist=hist_init(n, spec.hist_buckets),
+            deps_len_hist=hist_init(n, DEPS_LEN_BUCKETS),
+            wait_delay_hist=hist_init(n, spec.hist_buckets),
         )
 
     # ------------------------------------------------------------------
@@ -259,6 +273,10 @@ def make_protocol(
 
         # register under the proposed clock (update_clock, caesar.rs:314-318)
         st = st._replace(
+            # start time for the CommitLatency metric (caesar.rs:299-302)
+            start_ms=st.start_ms.at[p, dot].set(
+                jnp.where(active, now, st.start_ms[p, dot])
+            ),
             status=st.status.at[p, dot].set(
                 jnp.where(active, PROPOSE, st.status[p, dot])
             ),
@@ -299,6 +317,10 @@ def make_protocol(
                 jnp.where(wait, bm_pack(remaining, BW), st.blockedby[p, dot])
             ),
             waiting=st.waiting.at[p, dot].set(st.waiting[p, dot] | wait),
+            # wait start for the WaitConditionDelay metric (caesar.rs:490-493)
+            wait_start_ms=st.wait_start_ms.at[p, dot].set(
+                jnp.where(wait, now, st.wait_start_ms[p, dot])
+            ),
         )
 
         ack_clock = jnp.where(reject, new_clock, rclock)
@@ -373,6 +395,19 @@ def make_protocol(
             ),
             bufc_deps=st.bufc_deps.at[p, dot].set(
                 jnp.where(is_start, rdeps, st.bufc_deps[p, dot])
+            ),
+        )
+
+        # CommitLatency (propose receipt -> commit, when the MCommit came from
+        # the dot's coordinator, caesar.rs:645-658) and CommittedDepsLen
+        # (before the self-dep removal, caesar.rs:661-665)
+        st = st._replace(
+            commit_lat_hist=hist_add(
+                st.commit_lat_hist, p, now - st.start_ms[p, dot],
+                can & (mfrom == dot_proc(dot, max_seq)),
+            ),
+            deps_len_hist=hist_add(
+                st.deps_len_hist, p, bm_count(rdeps), can
             ),
         )
 
@@ -500,6 +535,11 @@ def make_protocol(
                 jnp.where(do_rej, REJECT, st.status[p, wc])
             ),
             waiting=st.waiting.at[p, wc].set(st.waiting[p, wc] & ~has),
+            # WaitConditionDelay: wait entry -> end_of_wait (caesar.rs:1055-1070)
+            wait_delay_hist=hist_add(
+                st.wait_delay_hist, p, now - st.wait_start_ms[p, wc],
+                do_acc | do_rej,
+            ),
         )
         ack_clock = jnp.where(do_rej, new_clock, st.clock_of[p, wc])
         ack_deps = jnp.where(do_rej, nack_deps, st.deps[p, wc])
@@ -574,6 +614,9 @@ def make_protocol(
             "commits": st.commit_count,
             "fast": st.fast_count,
             "slow": st.slow_count,
+            "commit_latency_hist": st.commit_lat_hist,
+            "committed_deps_len_hist": st.deps_len_hist,
+            "wait_condition_delay_hist": st.wait_delay_hist,
         }
 
     return ProtocolDef(

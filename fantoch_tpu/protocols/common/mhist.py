@@ -1,0 +1,37 @@
+"""Device-side bucketed metric histograms.
+
+The reference collects per-process metric histograms through
+`Metrics::collect` into exact value→count maps (reference:
+`fantoch/src/metrics/mod.rs:16-68`; protocol kinds `protocol/mod.rs:184-199`,
+executor kinds `executor/mod.rs:123-130`). On device each collected kind is a
+dense `[n, B]` int32 count tensor where bucket i counts value i; the last
+bucket is the tail bucket (counts every value >= B-1, the Prometheus-style
+"+Inf" convention) so recording is a clipped scatter-add and never loses
+events. Host side, `fantoch_tpu.core.metrics.Histogram.from_buckets` turns a
+row back into the exact histogram (lossless when nothing landed in the tail).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def hist_init(n: int, buckets: int) -> jnp.ndarray:
+    return jnp.zeros((n, buckets), jnp.int32)
+
+
+def hist_add(h: jnp.ndarray, p, value, enable) -> jnp.ndarray:
+    """Count `value` for process row `p` (clipped into the tail bucket)."""
+    idx = jnp.clip(value, 0, h.shape[1] - 1)
+    return h.at[p, idx].add(jnp.asarray(enable).astype(jnp.int32))
+
+
+def distinct_count(keys) -> jnp.ndarray:
+    """Number of distinct values in a command's key-slot row — the
+    `cmd.total_key_count()` of a merged command whose padding repeats keys
+    (CommandKeyCount metric, `tempo.rs:275-283`)."""
+    kpc = keys.shape[0]
+    cnt = jnp.int32(1)
+    for i in range(1, kpc):
+        seen = jnp.stack([keys[j] == keys[i] for j in range(i)]).any()
+        cnt = cnt + jnp.where(seen, 0, 1)
+    return cnt

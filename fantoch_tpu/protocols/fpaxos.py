@@ -47,6 +47,7 @@ from ..engine.types import (
     outbox_row,
 )
 from ..executors import slot as slot_executor
+from .common.mhist import distinct_count, hist_add, hist_init
 
 MFORWARD = 0
 MACCEPT = 1
@@ -76,12 +77,12 @@ class FPaxosState(NamedTuple):
     prev_stable: jnp.ndarray  # [n] int32
     stable_count: jnp.ndarray  # [n] int32 Stable metric
     commit_count: jnp.ndarray  # [n] int32 MChosen handled
+    key_count_hist: jnp.ndarray  # [n, KPC+2] CommandKeyCount at the leader
+    # (fpaxos.rs:168-174)
 
 
 def make_protocol(n: int, keys_per_command: int = 1) -> ProtocolDef:
-    # `keys_per_command` is accepted for factory-signature uniformity across
-    # protocols; the slot executor reads it from `ctx.spec` instead
-    del keys_per_command
+    KPC = keys_per_command
     MSG_W = 3
     MAX_OUT = 2
     MAX_EXEC = 1
@@ -108,6 +109,7 @@ def make_protocol(n: int, keys_per_command: int = 1) -> ProtocolDef:
             prev_stable=jnp.zeros((n,), jnp.int32),
             stable_count=jnp.zeros((n,), jnp.int32),
             commit_count=jnp.zeros((n,), jnp.int32),
+            key_count_hist=hist_init(n, KPC + 2),
         )
 
     def _leader_assign(ctx, st: FPaxosState, p, dot, enable):
@@ -117,6 +119,11 @@ def make_protocol(n: int, keys_per_command: int = 1) -> ProtocolDef:
         idx = slot - 1
         b0 = ctx.env.leader + 1
         st = st._replace(
+            # the leader records command size when spawning the commander
+            # (fpaxos.rs:168-174)
+            key_count_hist=hist_add(
+                st.key_count_hist, p, distinct_count(ctx.cmds.keys[dot]), enable
+            ),
             last_slot=st.last_slot.at[p].add(enable.astype(jnp.int32)),
             cmdr_alive=st.cmdr_alive.at[p, idx].set(
                 jnp.where(enable, True, st.cmdr_alive[p, idx])
@@ -244,6 +251,7 @@ def make_protocol(n: int, keys_per_command: int = 1) -> ProtocolDef:
         return {
             "stable": st.stable_count,
             "commits": st.commit_count,
+            "command_key_count_hist": st.key_count_hist,
         }
 
     return ProtocolDef(

@@ -64,6 +64,7 @@ from ..executors import table as table_executor
 from .common import gc as gc_mod
 from .common import sharding
 from .common import synod as synod_mod
+from .common.mhist import distinct_count, hist_add, hist_init
 
 MCOLLECT = 0
 MCOLLECTACK = 1
@@ -112,6 +113,7 @@ class TempoState(NamedTuple):
     slow_count: jnp.ndarray  # [n] int32
     slow_read_count: jnp.ndarray  # [n] int32 slow paths taken by reads (NFR)
     commit_count: jnp.ndarray  # [n] int32
+    key_count_hist: jnp.ndarray  # [n, KPC+2] CommandKeyCount (tempo.rs:275-283)
 
 
 def make_protocol(
@@ -170,6 +172,7 @@ def make_protocol(
             slow_count=z(n),
             slow_read_count=z(n),
             commit_count=z(n),
+            key_count_hist=hist_init(n, KPC + 2),
         )
 
     # ------------------------------------------------------------------
@@ -306,6 +309,11 @@ def make_protocol(
     # ------------------------------------------------------------------
 
     def submit(ctx, st: TempoState, p, dot, now):
+        st = st._replace(
+            key_count_hist=hist_add(
+                st.key_count_hist, p, distinct_count(ctx.cmds.keys[dot]), True
+            )
+        )
         st, clock, ss, es = _proposal(ctx, st, p, dot, jnp.int32(0), jnp.bool_(True))
         # store coordinator votes for later aggregation (tempo.rs:297-310)
         st = st._replace(
@@ -337,8 +345,14 @@ def make_protocol(
 
     def h_mfwd(ctx, st: TempoState, p, src, payload, now):
         """MForwardSubmit at this shard's designated coordinator: make the
-        shard-local proposal and start this shard's collect round."""
+        shard-local proposal and start this shard's collect round
+        (handle_submit re-runs here, so CommandKeyCount records again)."""
         dot = payload[0]
+        st = st._replace(
+            key_count_hist=hist_add(
+                st.key_count_hist, p, distinct_count(ctx.cmds.keys[dot]), True
+            )
+        )
         st, clock, ss, es = _proposal(ctx, st, p, dot, jnp.int32(0), jnp.bool_(True))
         st = st._replace(
             votes_s=st.votes_s.at[p, dot, :, ctx.pid].set(ss),
@@ -659,6 +673,7 @@ def make_protocol(
             "fast": st.fast_count,
             "slow_reads": st.slow_read_count,
             "slow": st.slow_count,
+            "command_key_count_hist": st.key_count_hist,
         }
 
     periodic_events = [("garbage_collection", lambda cfg: cfg.gc_interval_ms)]

@@ -73,6 +73,19 @@ def check(st, metrics, spec):
     # cross-replica execution order agreement per key
     assert (st.exec.order_cnt == st.exec.order_cnt[0]).all()
     assert (st.exec.order_hash == st.exec.order_hash[0]).all(), st.exec.order_hash
+    # collected metric histograms (caesar.rs:645-670): one CommitLatency and
+    # one CommittedDepsLen entry per commit at every process, all positive
+    # latencies (propose receipt -> commit receipt spans at least one hop in
+    # this placement); ExecutionDelay collected per executed command
+    n = st.exec.executed_count.shape[0]
+    cl = summary.hist_stats(np.asarray(metrics["commit_latency_hist"]).sum(axis=0))
+    dl = summary.hist_stats(
+        np.asarray(metrics["committed_deps_len_hist"]).sum(axis=0)
+    )
+    assert cl["count"] == n * total and cl["avg"] > 0, cl
+    assert dl["count"] == n * total, dl
+    ed = summary.hist_stats(np.asarray(st.exec.delay_hist).sum(axis=0))
+    assert ed["count"] == n * total, ed
 
 
 def test_caesar_wait_n3_f1():

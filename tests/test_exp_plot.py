@@ -63,6 +63,28 @@ def test_grid_db_plots(tmp_path):
         plots.heatmap_plot(basics, "conflict", "seed", out + "/hm.png")
     )
     assert "commits" in plots.metrics_table([e])
+    # executor metrics ride the same store (graph executor families)
+    assert (e.metrics["executor_out_requests"] == 0).all()  # single shard
+    assert "executor_execution_delay" in plots.metrics_table([e])
+    # nfr_plot renders grouped bars over any config key (read_only here is
+    # constant 0 across entries; the figure still renders)
+    assert os.path.isfile(
+        plots.nfr_plot({"basic": basics, "atlas": [e]}, out + "/nfr.png")
+    )
+    # recovery_plot renders timeline data rows (externally collected in the
+    # reference, fantoch_plot/eurosys20_data/recovery)
+    assert os.path.isfile(
+        plots.recovery_plot(
+            {
+                "Taiwan": {"atlas": [100, 120, 400, 130], "fpaxos": [200] * 4},
+                "Finland": {"atlas": [90, 95, 300, 99], "fpaxos": [150] * 4},
+            },
+            out + "/recovery.png",
+        )
+    )
+    # dstat table: every sweep dir carries a harness resource sample
+    table = plots.dstat_table(root)
+    assert "wall_s" in table and len(table.splitlines()) == 3, table
 
 
 def test_batching_grid_and_plot(tmp_path):

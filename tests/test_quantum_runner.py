@@ -137,6 +137,48 @@ def test_quantum_runner_matches_event_engine_tempo():
         )
 
 
+def test_quantum_runner_matches_event_engine_atlas():
+    """Dependency-graph protocols under the runner: per-key dep tracking,
+    quorum threshold checks, and the graph executor's closure ordering
+    match the event engine exactly."""
+    from fantoch_tpu.protocols import atlas as atlas_proto
+
+    st, rst = _run_both_engines(
+        atlas_proto.make_protocol(8, 1), Config(n=8, f=1, gc_interval_ms=100)
+    )
+    for counter in ("commit_count", "fast_count", "slow_count"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(rst.proto, counter)),
+            np.asarray(getattr(st.proto, counter)),
+        )
+    np.testing.assert_array_equal(
+        np.asarray(rst.exec.executed_count), np.asarray(st.exec.executed_count)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(rst.exec.order_hash), np.asarray(st.exec.order_hash)
+    )
+
+
+def test_quantum_runner_matches_event_engine_caesar():
+    """The wait-condition protocol under the runner: MUnblock self-send
+    cascades, retry aggregation, and the predecessors executor match the
+    event engine."""
+    from fantoch_tpu.protocols import caesar as caesar_proto
+
+    st, rst = _run_both_engines(
+        caesar_proto.make_protocol(8, 1, max_seq=16),
+        Config(n=8, f=1, gc_interval_ms=100),
+    )
+    for counter in ("commit_count", "stable_count"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(rst.proto, counter)),
+            np.asarray(getattr(st.proto, counter)),
+        )
+    np.testing.assert_array_equal(
+        np.asarray(rst.exec.order_hash), np.asarray(st.exec.order_hash)
+    )
+
+
 def test_quantum_runner_matches_event_engine_fpaxos():
     """Leader-based routing under the runner: submit forwarding to the
     leader device, the commander/acceptor flow, and the write-quorum GC

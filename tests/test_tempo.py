@@ -93,6 +93,11 @@ def check(st, metrics, spec, keys_per_command=1):
     # cross-replica execution order agreement per key
     assert (st.exec.order_cnt == st.exec.order_cnt[0]).all()
     assert (st.exec.order_hash == st.exec.order_hash[0]).all(), st.exec.order_hash
+    # CommandKeyCount (tempo.rs:275-283): one entry per submit, recorded at
+    # the coordinator, value = the command's distinct key count
+    kh = np.asarray(metrics["command_key_count_hist"]).sum(axis=0)
+    assert kh.sum() == total, kh
+    assert kh[: keys_per_command + 1].sum() == total  # values <= KPC
 
 
 def test_tempo_n3_f1():
