@@ -70,17 +70,27 @@ def build_batch(pdef, n_configs, commands_per_client, conflict_rate=50):
 def run_protocol(name, pdef, n_configs, commands_per_client, chunk_steps):
     spec, wl, envs = build_batch(pdef, n_configs, commands_per_client)
     init, chunk, done = sweep.make_chunked_runner(spec, pdef, wl, chunk_steps)
-    # warm-up: compile both programs on a throwaway state
-    warm = chunk(envs, init(envs))
-    jax.block_until_ready(warm)
-    del warm
 
-    t0 = time.time()
-    st = init(envs)
-    while not done(st):
-        st = chunk(envs, st)
-    jax.block_until_ready(st)
-    elapsed = time.time() - t0
+    def run_once():
+        # warm-up: compile both programs on a throwaway state
+        warm = chunk(envs, init(envs))
+        jax.block_until_ready(warm)
+        del warm
+        t0 = time.time()
+        st = init(envs)
+        while not done(st):
+            st = chunk(envs, st)
+        jax.block_until_ready(st)
+        return st, time.time() - t0
+
+    try:
+        st, elapsed = run_once()
+    except Exception as e:  # transient tunnel fault: wait and retry once
+        if "UNAVAILABLE" not in str(e):
+            raise
+        print(f"  {name}: TPU UNAVAILABLE, retrying in 30s", file=sys.stderr)
+        time.sleep(30)
+        st, elapsed = run_once()
 
     res = sweep.summarize_batch(st)
     events = int(res["steps"].sum())
@@ -96,19 +106,24 @@ def run_protocol(name, pdef, n_configs, commands_per_client, chunk_steps):
 
 def main():
     scale = float(os.environ.get("BENCH_SCALE", "1"))
-    chunk_steps = int(os.environ.get("BENCH_CHUNK_STEPS", "4000"))
+    chunk_env = os.environ.get("BENCH_CHUNK_STEPS")
     n = 3
+    # per-protocol chunk lengths keep each device call well under the
+    # tunneled-TPU ~40s stall limit at the default batch widths (the
+    # while-loop iteration rate is roughly batch-independent, so chunk
+    # length ~ wall time per call; larger batches need shorter chunks)
     runs = [
-        # (name, pdef, configs, commands/client)
-        ("basic", basic_proto.make_protocol(n, 1), int(1024 * scale), 50),
-        ("tempo", tempo_proto.make_protocol(n, 1), int(256 * scale), 20),
-        ("atlas", atlas_proto.make_protocol(n, 1), int(64 * scale), 10),
+        # (name, pdef, configs, commands/client, chunk_steps)
+        ("basic", basic_proto.make_protocol(n, 1), int(2048 * scale), 50, 1200),
+        ("tempo", tempo_proto.make_protocol(n, 1), int(512 * scale), 20, 1500),
+        ("atlas", atlas_proto.make_protocol(n, 1), int(128 * scale), 10, 2000),
     ]
     total_events, total_time = 0, 0.0
     all_ok = True
-    for name, pdef, n_configs, cmds in runs:
+    for name, pdef, n_configs, cmds, chunk_steps in runs:
         events, elapsed, ok = run_protocol(
-            name, pdef, max(n_configs, 1), cmds, chunk_steps
+            name, pdef, max(n_configs, 1), cmds,
+            int(chunk_env) if chunk_env else chunk_steps,
         )
         total_events += events
         total_time += elapsed

@@ -112,6 +112,8 @@ def cmd_sweep(args) -> int:
         mesh=mesh,
         chunk_steps=args.chunk_steps or None,
         verbose=args.verbose,
+        profile_dir=args.profile or None,
+        metrics_log=args.metrics_log or None,
     )
     print(json.dumps({"points": len(points), "dirs": dirs}))
     return 0
@@ -343,6 +345,12 @@ def main(argv=None) -> int:
     pw.add_argument("--mesh", action="store_true", help="shard over all devices")
     pw.add_argument("--chunk-steps", type=int, default=0)
     pw.add_argument("--verbose", action="store_true")
+    pw.add_argument("--profile", default="",
+                    help="wrap device runs in jax.profiler.trace to this dir"
+                         " (the flamegraph run-mode analogue)")
+    pw.add_argument("--metrics-log", default="",
+                    help="append per-chunk metric snapshots to this file"
+                         " (requires --chunk-steps; metrics_logger analogue)")
     pw.set_defaults(fn=cmd_sweep)
 
     pp = sub.add_parser("plot", help="figures + stats from a results root")

@@ -68,6 +68,30 @@ class Planet:
         return cls(latencies)
 
     @classmethod
+    def from_dat_dir(cls, path: str) -> "Planet":
+        """Load a directory of `.dat` ping files — the reference's on-disk
+        format (`fantoch/src/planet/dat.rs:30-75`): one `<region>.dat` file
+        per source, one `min/avg/max/dev:region` line per destination; only
+        the average is kept, floored to integer ms like the reference's
+        `latency as u64`."""
+        latencies: Dict[str, Dict[str, int]] = {}
+        for fname in sorted(os.listdir(path)):
+            if not fname.endswith(".dat"):
+                continue
+            src = fname[: -len(".dat")]
+            rows: Dict[str, int] = {}
+            with open(os.path.join(path, fname)) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    stats, dst = line.split(":", 1)
+                    avg = float(stats.split("/")[1])
+                    rows[dst] = int(avg)
+            latencies[src] = rows
+        return cls(latencies)
+
+    @classmethod
     def equidistant(cls, planet_distance: int, region_number: int) -> Tuple[List[str], "Planet"]:
         regions = [f"r_{i}" for i in range(region_number)]
         latencies = {

@@ -76,10 +76,19 @@ class GraphExecState(NamedTuple):
     recv_ms: jnp.ndarray  # [n, DOTS] int32 vertex-creation time
     chain_hist: jnp.ndarray  # [n, CB] ChainSize: committed SCC sizes (graph/mod.rs:493)
     delay_hist: jnp.ndarray  # [n, HB] ExecutionDelay: commit->execute ms (graph/mod.rs:518)
+    # execution log (exec_log builds only; [n, 1] dummies otherwise):
+    # execution-info arrival order, flat dot + 1 per handle call (the
+    # reference's opt-in execution_logger task output,
+    # run/task/server/execution_logger.rs; replayable through
+    # exp.harness.replay_graph_stream like bin/graph_executor_replay.rs)
+    log_dot: jnp.ndarray  # [n, 2*DOTS] int32
+    log_len: jnp.ndarray  # [n] int32
     ready: ReadyRing
 
 
-def make_executor(n: int, max_deps: int, shards: int = 1) -> ExecutorDef:
+def make_executor(
+    n: int, max_deps: int, shards: int = 1, exec_log: bool = False
+) -> ExecutorDef:
     D = max_deps
     EW = 1 + D
 
@@ -98,6 +107,8 @@ def make_executor(n: int, max_deps: int, shards: int = 1) -> ExecutorDef:
             recv_ms=jnp.zeros((n, DOTS), jnp.int32),
             chain_hist=hist_init(n, CHAIN_BUCKETS),
             delay_hist=hist_init(n, spec.hist_buckets),
+            log_dot=jnp.zeros((n, 2 * DOTS if exec_log else 1), jnp.int32),
+            log_len=jnp.zeros((n,), jnp.int32),
             ready=ready_init(n, ready_capacity(spec)),
         )
 
@@ -201,6 +212,13 @@ def make_executor(n: int, max_deps: int, shards: int = 1) -> ExecutorDef:
                 jnp.where(est.committed[p, dot], est.recv_ms[p, dot], now)
             ),
         )
+        if exec_log:
+            est = est._replace(
+                log_dot=est.log_dot.at[p, est.log_len[p]].set(
+                    dot + 1, mode="drop"
+                ),
+                log_len=est.log_len.at[p].add(1),
+            )
         return _try_execute(ctx, est, p, now)
 
     def drain(ctx, est: GraphExecState, p):

@@ -15,6 +15,7 @@ conflict rate, read-only %, placement and seed vary freely as Env data.
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import resource
 import time
@@ -160,11 +161,28 @@ def run_grid(
     mesh: Optional[jax.sharding.Mesh] = None,
     chunk_steps: Optional[int] = None,
     verbose: bool = False,
+    profile_dir: Optional[str] = None,
+    metrics_log: Optional[str] = None,
 ) -> List[str]:
     """Run every grid point and persist one results dir per shape bucket.
 
+    `profile_dir` wraps every bucket's device run in a `jax.profiler.trace`
+    (XPlane/TensorBoard trace under that directory) — the device analogue of
+    the reference harness's flamegraph/heaptrack run modes
+    (`fantoch_exp/src/lib.rs:42-70` `RunMode::run_command`).
+
+    `metrics_log` (requires `chunk_steps`) appends one JSON line of
+    in-flight aggregate metrics per executed chunk — the periodic
+    metrics-snapshot file of the reference's `metrics_logger_task`
+    (`fantoch/src/run/task/server/metrics_logger.rs`, wiring
+    `run/mod.rs:333-351`).
+
     Returns the created directories (load them with `ResultsDB.load` on the
     parent root)."""
+    if metrics_log and not chunk_steps:
+        raise ValueError(
+            "metrics_log snapshots are taken between chunks; pass chunk_steps"
+        )
     planet = planet or Planet.new()
     client_regions = list(client_regions or ["us-west1", "us-west2"])
 
@@ -236,20 +254,30 @@ def run_grid(
                 )
             batched = sweep.shard_envs(batched, mesh)
 
+        trace_ctx = (
+            jax.profiler.trace(profile_dir)
+            if profile_dir
+            else contextlib.nullcontext()
+        )
         t0 = time.perf_counter()
-        if chunk_steps:
-            init, chunk, done = sweep.make_chunked_runner(spec, pdef, wl, chunk_steps)
-            st = init(batched)
-            while not done(st):
-                st = chunk(batched, st)
-                if verbose:
-                    print(
-                        f"bucket {bi}: steps "
-                        f"{np.asarray(st.step).sum()}", flush=True
-                    )
-        else:
-            st = sweep.run_batch(spec, pdef, wl, batched)
-        jax.block_until_ready(st)
+        with trace_ctx:
+            if chunk_steps:
+                init, chunk, done = sweep.make_chunked_runner(
+                    spec, pdef, wl, chunk_steps
+                )
+                st = init(batched)
+                while not done(st):
+                    st = chunk(batched, st)
+                    if metrics_log:
+                        _append_metrics_snapshot(metrics_log, bi, st, pdef)
+                    if verbose:
+                        print(
+                            f"bucket {bi}: steps "
+                            f"{np.asarray(st.step).sum()}", flush=True
+                        )
+            else:
+                st = sweep.run_batch(spec, pdef, wl, batched)
+            jax.block_until_ready(st)
         wall_s = time.perf_counter() - t0
         st = jax.tree_util.tree_map(np.asarray, st)
         B = len(envs)
@@ -288,6 +316,44 @@ def run_grid(
         if verbose:
             print(f"bucket {bi} ({bkey}) -> {out_dirs[-1]}", flush=True)
     return out_dirs
+
+
+def _append_metrics_snapshot(path: str, bucket: int, st, pdef) -> None:
+    """One in-flight metrics line per chunk (metrics_logger_task analogue):
+    simulated-time/step progress plus summed protocol counters."""
+    import json
+
+    snap: Dict[str, Any] = {
+        "ts": time.time(),
+        "bucket": bucket,
+        "steps": int(np.asarray(st.step).sum()),
+        "now_ms_max": int(np.asarray(st.now).max()),
+        "clients_done": int(np.asarray(st.clients_done).sum()),
+    }
+    if pdef.metrics is not None:
+        for k, v in pdef.metrics(st.proto).items():
+            if not k.endswith("_hist"):
+                snap[k] = int(np.asarray(v).sum())
+    with open(path, "a") as f:
+        f.write(json.dumps(snap) + "\n")
+
+
+def extract_graph_log(st, p: int) -> List[List[int]]:
+    """Pull process `p`'s execution log out of a finished graph-executor run:
+    `[dot, dep, ...]` commit records in arrival order, the same shape
+    `replay_graph_stream` consumes (the reference's execution_logger output
+    fed to `graph_executor_replay`, `fantoch_ps/src/bin/
+    graph_executor_replay.rs:13-38`)."""
+    exec_st = st.exec
+    length = int(np.asarray(exec_st.log_len)[p])
+    log = np.asarray(exec_st.log_dot)[p, :length]
+    deps = np.asarray(exec_st.deps)[p]
+    rows: List[List[int]] = []
+    for flat1 in log:
+        dot = int(flat1) - 1
+        row = [dot] + [int(d) - 1 for d in deps[dot] if d > 0]
+        rows.append(row)
+    return rows
 
 
 def replay_graph_stream(rows: Sequence[Sequence[int]], n: int = 1) -> dict:

@@ -14,6 +14,7 @@ from .atlas import _make
 
 
 def make_protocol(
-    n: int, keys_per_command: int = 1, nfr: bool = False, shards: int = 1
+    n: int, keys_per_command: int = 1, nfr: bool = False, shards: int = 1,
+    exec_log: bool = False,
 ) -> ProtocolDef:
-    return _make("epaxos", n, keys_per_command, nfr, shards)
+    return _make("epaxos", n, keys_per_command, nfr, shards, exec_log)

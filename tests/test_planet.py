@@ -70,3 +70,19 @@ def test_distance_matrix():
     assert d.dtype == np.int32
     assert d[0, 0] == 0
     assert d[0, 1] == 12  # 25 // 2
+
+
+def test_from_dat_dir(tmp_path):
+    """The reference's on-disk .dat format loads directly
+    (min/avg/max/dev:region lines, planet/dat.rs:30-75)."""
+    (tmp_path / "a.dat").write_text(
+        "0.1/0.4/1.0/0.02:a\n10.5/12.9/20.0/0.5:b\n"
+    )
+    (tmp_path / "b.dat").write_text(
+        "11.0/13.2/19.0/0.4:a\n0.2/0.3/0.9/0.01:b\n"
+    )
+    planet = Planet.from_dat_dir(str(tmp_path))
+    assert planet.regions() == ["a", "b"]
+    assert planet.ping_latency("a", "b") == 12  # avg floored
+    assert planet.ping_latency("b", "a") == 13
+    assert planet.ping_latency("a", "a") == 0

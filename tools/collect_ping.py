@@ -1,0 +1,91 @@
+"""Collect a region-to-region ping matrix in the reference's `.dat` format.
+
+The analogue of the reference's `ping_exp_gcp/` collection scripts: run this
+on each machine of a deployment with a hosts file mapping region names to
+addresses; it pings every peer and writes `<my_region>.dat` with one
+
+    min/avg/max/mdev:region
+
+line per destination (the format `fantoch/src/planet/dat.rs:30-75` parses and
+`fantoch_tpu.core.planet.Planet.from_dat_dir` loads directly).
+
+Usage:
+    python tools/collect_ping.py --region us-east1 \
+        --hosts hosts.txt --count 10 --out latency_mine/
+
+hosts.txt: one `region address` pair per line (`region address:port` with
+`--mode tcp`, which measures TCP connect round-trips instead — useful where
+ICMP is unavailable; fantoch servers listen on TCP anyway).
+"""
+import argparse
+import math
+import os
+import re
+import socket
+import subprocess
+import sys
+import time
+
+
+def ping_stats(address: str, count: int) -> str:
+    """Return `min/avg/max/mdev` for one destination (ms, iputils format)."""
+    out = subprocess.run(
+        ["ping", "-nq", "-c", str(count), address],
+        capture_output=True, text=True, timeout=30 + count,
+    ).stdout
+    m = re.search(r"= ([\d.]+)/([\d.]+)/([\d.]+)/([\d.]+)", out)
+    if not m:
+        raise RuntimeError(f"no ping statistics from {address}:\n{out}")
+    return "/".join(m.groups())
+
+
+def tcp_stats(address: str, count: int) -> str:
+    """`min/avg/max/mdev` of TCP connect round-trips to `host:port` (ms)."""
+    host, port = address.rsplit(":", 1)
+    samples = []
+    for _ in range(count):
+        t0 = time.perf_counter()
+        with socket.create_connection((host, int(port)), timeout=10):
+            pass
+        samples.append((time.perf_counter() - t0) * 1000.0)
+    avg = sum(samples) / len(samples)
+    dev = math.sqrt(sum((s - avg) ** 2 for s in samples) / len(samples))
+    return f"{min(samples):.3f}/{avg:.3f}/{max(samples):.3f}/{dev:.3f}"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--region", required=True, help="this machine's region name")
+    ap.add_argument("--hosts", required=True, help="file of 'region address' lines")
+    ap.add_argument("--count", type=int, default=10, help="pings per destination")
+    ap.add_argument("--out", default=".", help="output directory")
+    ap.add_argument("--mode", choices=["icmp", "tcp"], default="icmp",
+                    help="icmp uses the ping binary; tcp measures connect RTTs")
+    args = ap.parse_args(argv)
+
+    hosts = []
+    with open(args.hosts) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) == 2:
+                hosts.append((parts[0], parts[1]))
+
+    # measure everything first so a failed peer can't leave a truncated
+    # .dat behind (Planet.from_dat_dir would load it without error)
+    measure = tcp_stats if args.mode == "tcp" else ping_stats
+    lines = []
+    for region, address in hosts:
+        stats = measure(address, args.count)
+        lines.append(f"{stats}:{region}\n")
+        print(f"{args.region} -> {region}: {stats}", file=sys.stderr)
+
+    os.makedirs(args.out, exist_ok=True)
+    path = os.path.join(args.out, f"{args.region}.dat")
+    with open(path, "w") as f:
+        f.writelines(lines)
+    print(path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
