@@ -45,7 +45,10 @@ def cmd_sim(args) -> int:
         n=args.n,
         f=args.f,
         clients_per_region=args.clients,
+        key_gen=args.key_gen,
         conflict_rate=args.conflict,
+        zipf_coefficient=args.zipf_coefficient,
+        zipf_total_keys=args.zipf_keys,
         keys_per_command=args.keys_per_command,
         commands_per_client=args.commands,
         read_only_percentage=args.read_only,
@@ -77,6 +80,11 @@ def cmd_sim(args) -> int:
 
 def cmd_sweep(args) -> int:
     from .exp.harness import Point, run_grid
+
+    if args.metrics_log and not args.chunk_steps:
+        print("sweep: --metrics-log snapshots are taken between chunks;"
+              " pass --chunk-steps", file=sys.stderr)
+        return 2
 
     points = []
     for proto in _csv(args.protocols):
@@ -315,6 +323,10 @@ def main(argv=None) -> int:
     ps.add_argument("--f", type=int, default=1)
     ps.add_argument("--clients", type=int, default=2)
     ps.add_argument("--conflict", type=int, default=0)
+    ps.add_argument("--key-gen", choices=["conflict_pool", "zipf"],
+                    default="conflict_pool")
+    ps.add_argument("--zipf-coefficient", type=float, default=1.0)
+    ps.add_argument("--zipf-keys", type=int, default=64)
     ps.add_argument("--keys-per-command", type=int, default=1)
     ps.add_argument("--commands", type=int, default=100)
     ps.add_argument("--read-only", type=int, default=0)

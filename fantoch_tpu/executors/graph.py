@@ -89,6 +89,13 @@ class GraphExecState(NamedTuple):
 def make_executor(
     n: int, max_deps: int, shards: int = 1, exec_log: bool = False
 ) -> ExecutorDef:
+    # under partial replication a dot can be re-delivered (MDEPREPLY
+    # re-requests), so the arrival log would hold duplicates whose per-arrival
+    # deps are not reconstructible from final state — like the reference's
+    # replay bin, execution logging is a single-shard debugging tool
+    assert not (exec_log and shards > 1), (
+        "exec_log replay is single-shard only"
+    )
     D = max_deps
     EW = 1 + D
 

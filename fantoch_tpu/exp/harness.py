@@ -25,6 +25,22 @@ import jax
 import numpy as np
 
 
+from ..core.config import Config
+from ..core.planet import Planet
+from ..core.workload import KeyGen, Workload
+from ..engine import setup, summary, sweep
+from ..engine.types import ProtocolDef
+from ..plot import db as results_db
+from ..protocols import atlas as atlas_proto
+from ..protocols import basic as basic_proto
+from ..protocols import caesar as caesar_proto
+from ..protocols import epaxos as epaxos_proto
+from ..protocols import fpaxos as fpaxos_proto
+from ..protocols import tempo as tempo_proto
+
+PROTOCOLS = ("basic", "tempo", "atlas", "epaxos", "janus", "fpaxos", "caesar")
+
+
 def _dstat_sample(wall_s: float, st) -> Dict[str, float]:
     """Host/device resource snapshot for one sweep bucket — the harness's
     stand-in for the reference's per-machine dstat collection
@@ -46,21 +62,6 @@ def _dstat_sample(wall_s: float, st) -> Dict[str, float]:
         pass
     return sample
 
-from ..core.config import Config
-from ..core.planet import Planet
-from ..core.workload import KeyGen, Workload
-from ..engine import setup, summary, sweep
-from ..engine.types import ProtocolDef
-from ..plot import db as results_db
-from ..protocols import atlas as atlas_proto
-from ..protocols import basic as basic_proto
-from ..protocols import caesar as caesar_proto
-from ..protocols import epaxos as epaxos_proto
-from ..protocols import fpaxos as fpaxos_proto
-from ..protocols import tempo as tempo_proto
-
-PROTOCOLS = ("basic", "tempo", "atlas", "epaxos", "janus", "fpaxos", "caesar")
-
 
 @dataclasses.dataclass(frozen=True)
 class Point:
@@ -70,8 +71,13 @@ class Point:
     n: int
     f: int
     clients_per_region: int = 1
+    # key generator: "conflict_pool" (conflict_rate/pool_size) or "zipf"
+    # (zipf_coefficient/zipf_total_keys) — client/key_gen.rs KeyGen variants
+    key_gen: str = "conflict_pool"
     conflict_rate: int = 0
     pool_size: int = 1
+    zipf_coefficient: float = 1.0
+    zipf_total_keys: int = 64
     keys_per_command: int = 1
     commands_per_client: int = 100
     read_only_percentage: int = 0
@@ -89,9 +95,13 @@ class Point:
         return d
 
     def workload(self) -> Workload:
+        if self.key_gen == "zipf":
+            kg = KeyGen.zipf(self.zipf_coefficient, self.zipf_total_keys)
+        else:
+            kg = KeyGen.conflict_pool(self.conflict_rate, self.pool_size)
         return Workload(
             shard_count=1,
-            key_gen=KeyGen.conflict_pool(self.conflict_rate, self.pool_size),
+            key_gen=kg,
             keys_per_command=self.keys_per_command,
             commands_per_client=self.commands_per_client,
             payload_size=self.payload_size,
@@ -140,7 +150,10 @@ def _bucket_key(pt: Point) -> Tuple:
         pt.clients_per_region,
         pt.keys_per_command,
         pt.commands_per_client,
+        pt.key_gen,
         pt.pool_size,
+        pt.zipf_coefficient,
+        pt.zipf_total_keys,
         pt.open_loop_interval_ms,
         pt.batch_max_size,
         pt.batch_max_delay_ms,

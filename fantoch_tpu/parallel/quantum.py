@@ -22,15 +22,24 @@ axis; the only cross-device traffic is the message all_to_all plus scalar
 pmin/psum/pmax reductions — the traffic pattern of a real deployment,
 riding ICI instead of sockets.
 
-Command payloads follow the reference's message-carried distribution
-(`MStore{cmd}`, `MCollect{cmd}`): a submit broadcasts an engine-level
-`RK_CMD` record alongside the protocol's own messages; every device applies
-arriving records to its command-table replica *before* handling protocol
-messages of the same instant, so `has_cmd`-style handshakes observe the
-same ordering as under the event engine.
+Command distribution: a submit broadcasts an engine-level `RK_CMD` record to
+every device at the submission instant (delivered before any same-instant
+protocol message) — the exact semantics of the event engine's globally
+visible command table, which protocol messages may reference from any hop
+(the reference instead carries the command inside `MStore{cmd}` /
+`MCollect{cmd}` payloads; the record broadcast is the runner's equivalent).
 
-Constraints: `n == mesh axis size` (one process per device slice);
-single-shard; closed-loop clients.
+Partial replication follows the engine's shard routing: submits go to the
+client's connected process in the command's first key's shard, every shard
+runs its own agreement (the protocol's MForwardSubmit/MShardCommit
+machinery works unchanged), executors answer only their shard's keys, and
+per-key partial results ride 0-delay `RK_PARTIAL` messages to the client's
+owner device, which aggregates them (AggregatePending) and schedules the
+reply with the completing emitter's network delay — the same count-then-
+complete discipline as the engine's `_route_results`.
+
+Constraints: `n == mesh axis size` (one process per device slice, n = ranks
+x shards); closed-loop clients.
 
 Known boundary difference vs the event engine: the engine's loop guard reads
 the previous event's time, so it processes exactly one event past
@@ -65,11 +74,13 @@ from ..engine.types import (
 
 # runner-local message kinds: the lock-step engine reserves {0,1} and puts
 # protocol kinds at 2+; the runner inserts the command-record kind at 2 and
-# shifts protocol kinds to 3+ (translated back before pdef.handle)
+# the client partial-result kind at 3, shifting protocol kinds to 4+
+# (translated back before pdef.handle)
 RK_SUBMIT = KIND_SUBMIT  # 0
 RK_TO_CLIENT = KIND_TO_CLIENT  # 1
 RK_CMD = 2
-RK_PROTO_BASE = 3
+RK_PARTIAL = 3
+RK_PROTO_BASE = 4
 
 AXIS = "procs"
 
@@ -91,13 +102,17 @@ class LocalEnv(NamedTuple):
     conflict_rate: jnp.ndarray
     read_only_pct: jnp.ndarray
     seed: jnp.ndarray  # uint32[2]
+    shard_of: jnp.ndarray  # [n] shard of each global process
+    closest_shard_proc: jnp.ndarray  # [n, SHARDS]
     cl_present: jnp.ndarray  # [n, CM]
     cl_gcid: jnp.ndarray  # [n, CM] global client id (key-sampling identity)
     cl_group: jnp.ndarray  # [n, CM]
-    cl_dist_cp: jnp.ndarray  # [n, CM]
-    cl_dist_pc: jnp.ndarray  # [n, CM]
-    g2p: jnp.ndarray  # [C_TOTAL] coordinator process of each global client
+    cl_conn: jnp.ndarray  # [n, CM, SHARDS] connected process per shard
+    cl_dist_cp: jnp.ndarray  # [n, CM, SHARDS]
+    dist_pc: jnp.ndarray  # [n, C_TOTAL] process -> client delay
+    g2p: jnp.ndarray  # [C_TOTAL] owner process (shard-0 connection) per client
     g2s: jnp.ndarray  # [C_TOTAL] local slot of each global client
+    g2conn: jnp.ndarray  # [C_TOTAL, SHARDS] connected process per shard
 
 
 class RState(NamedTuple):
@@ -161,11 +176,8 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
     )
     assert not spec.reorder, "message reordering is an event-engine mode"
     assert spec.batch_max_size <= 1, "batching needs open-loop clients"
-    assert spec.shards == 1, (
-        "the distributed runner is single-shard (shard-aware protocols land"
-        " with the partial-replication protocol machinery)"
-    )
     n, C_TOTAL, S = spec.n, spec.n_clients, spec.pool_slots
+    SHARDS = spec.shards
     W = max(message_width(pdef, spec.keys_per_command), 4 + spec.keys_per_command)
     KPC = spec.keys_per_command
     DOTS = spec.dots
@@ -192,31 +204,34 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
     # ---------------- host-side construction ----------------
 
     def client_layout():
-        """Pad clients into [n, CM] slots keyed by their coordinator."""
-        client_proc = np.asarray(env.client_proc)[:, 0]
-        cm = max(1, max(int((client_proc == p).sum()) for p in range(n)))
+        """Pad clients into [n, CM] slots keyed by their *owner* — the
+        shard-0 connected process, which aggregates partial results
+        (AggregatePending at the client in the reference)."""
+        client_proc = np.asarray(env.client_proc)  # [C, SHARDS]
+        owner = client_proc[:, 0]
+        cm = max(1, max(int((owner == p).sum()) for p in range(n)))
         present = np.zeros((n, cm), bool)
         gcid = np.zeros((n, cm), np.int32)
         group = np.zeros((n, cm), np.int32)
-        dcp = np.zeros((n, cm), np.int32)
-        dpc = np.zeros((n, cm), np.int32)
+        conn = np.zeros((n, cm, SHARDS), np.int32)
+        dcp = np.zeros((n, cm, SHARDS), np.int32)
         g2p = np.zeros((C_TOTAL,), np.int32)
         g2s = np.zeros((C_TOTAL,), np.int32)
         fill = [0] * n
         for c in range(C_TOTAL):
-            p = int(client_proc[c])
+            p = int(owner[c])
             s = fill[p]
             fill[p] += 1
             present[p, s] = True
             gcid[p, s] = c
             group[p, s] = int(np.asarray(env.client_group)[c])
-            dcp[p, s] = int(np.asarray(env.dist_cp)[c, 0])
-            dpc[p, s] = int(np.asarray(env.dist_pc)[p, c])
+            conn[p, s] = client_proc[c]
+            dcp[p, s] = np.asarray(env.dist_cp)[c]
             g2p[c] = p
             g2s[c] = s
-        return cm, present, gcid, group, dcp, dpc, g2p, g2s
+        return cm, present, gcid, group, conn, dcp, g2p, g2s
 
-    CM, cl_present, cl_gcid, cl_group, cl_dcp, cl_dpc, g2p_np, g2s_np = client_layout()
+    CM, cl_present, cl_gcid, cl_group, cl_conn, cl_dcp, g2p_np, g2s_np = client_layout()
 
     lenv = LocalEnv(
         dist_pp=jnp.asarray(env.dist_pp),
@@ -233,13 +248,17 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         conflict_rate=jnp.asarray(env.conflict_rate),
         read_only_pct=jnp.asarray(env.read_only_pct),
         seed=jnp.asarray(env.seed),
+        shard_of=jnp.asarray(env.shard_of),
+        closest_shard_proc=jnp.asarray(env.closest_shard_proc),
         cl_present=jnp.asarray(cl_present),
         cl_gcid=jnp.asarray(cl_gcid),
         cl_group=jnp.asarray(cl_group),
+        cl_conn=jnp.asarray(cl_conn),
         cl_dist_cp=jnp.asarray(cl_dcp),
-        cl_dist_pc=jnp.asarray(cl_dpc),
+        dist_pc=jnp.asarray(env.dist_pc),
         g2p=jnp.asarray(g2p_np),
         g2s=jnp.asarray(g2s_np),
+        g2conn=jnp.asarray(np.asarray(env.client_proc)),
     )
 
     def init_state() -> RState:
@@ -248,7 +267,7 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         isq = np.zeros((n, IP), np.int32)
         ik = np.zeros((n, IP), np.int32)
         ipay = np.zeros((n, IP, W), np.int32)
-        # first command's workload sample for every slot in one vmapped
+        # first command's workload sample per global client in one vmapped
         # dispatch (matches the engine's init_state keys0/ro0, lockstep.py)
         seed_key = jax.random.wrap_key_data(lenv.seed)
         keys0, ro0 = jax.vmap(
@@ -256,27 +275,33 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
                 consts, seed_key, g, jnp.int32(0),
                 lenv.conflict_rate, lenv.read_only_pct,
             )
-        )(jnp.asarray(cl_gcid.reshape(-1)))
-        keys0 = np.asarray(keys0).reshape(n, CM, KPC)
-        ro0 = np.asarray(ro0).reshape(n, CM)
-        for p in range(n):
-            for s in range(CM):
-                if not bool(cl_present[p, s]):
-                    continue
-                iv[p, s] = True
-                it[p, s] = int(cl_dcp[p, s])
-                isq[p, s] = s
-                ik[p, s] = RK_SUBMIT
-                ipay[p, s, 0] = s  # local client slot
-                ipay[p, s, 1] = 1  # rifl 1
-                ipay[p, s, 2] = int(ro0[p, s])
-                ipay[p, s, 3 : 3 + KPC] = keys0[p, s]
+        )(jnp.arange(C_TOTAL, dtype=jnp.int32))
+        keys0 = np.asarray(keys0)  # [C_TOTAL, KPC]
+        ro0 = np.asarray(ro0)
+        client_proc = np.asarray(env.client_proc)
+        dist_cp = np.asarray(env.dist_cp)
+        fill = [0] * n
+        for c in range(C_TOTAL):
+            # the first submit goes to the client's connected process in the
+            # first command's target shard (first key's, workload.rs:154-185)
+            t = int(keys0[c, 0]) % SHARDS
+            p = int(client_proc[c, t])
+            s = fill[p]
+            fill[p] += 1
+            iv[p, s] = True
+            it[p, s] = int(dist_cp[c, t])
+            isq[p, s] = s
+            ik[p, s] = RK_SUBMIT
+            ipay[p, s, 0] = c  # global client id
+            ipay[p, s, 1] = 1  # rifl 1
+            ipay[p, s, 2] = int(ro0[c])
+            ipay[p, s, 3 : 3 + KPC] = keys0[c]
         return RState(
             now=jnp.int32(0),
             all_done=jnp.bool_(False),
             final_time=INF_TIME,
             step=jnp.zeros((n,), jnp.int32),
-            send_seq=jnp.full((n,), CM, jnp.int32),
+            send_seq=jnp.asarray(fill, jnp.int32),
             dropped=jnp.zeros((n,), jnp.int32),
             i_valid=jnp.asarray(iv),
             i_time=jnp.asarray(it),
@@ -322,11 +347,14 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         """
         return Env(
             dist_pp=lenv.dist_pp[myrow][None, :],
-            dist_pc=lenv.cl_dist_pc[myrow][None, :],
-            dist_cp=lenv.cl_dist_cp[myrow][:, None],
+            dist_pc=lenv.dist_pc[myrow][None, :],
+            dist_cp=lenv.cl_dist_cp[myrow][:, 0][:, None],
             client_proc=jnp.zeros((CM, 1), jnp.int32),
-            shard_of=jnp.zeros((1,), jnp.int32),
-            closest_shard_proc=jnp.zeros((1, 1), jnp.int32),
+            # shard identity is pid-indexed in handlers (ctx.env.shard_of[
+            # ctx.pid], own_coord's shard_of[coord]) -> full arrays; the
+            # closest-shard row is state-row-indexed -> our row at p=0
+            shard_of=lenv.shard_of,
+            closest_shard_proc=lenv.closest_shard_proc[myrow][None, :],
             client_group=lenv.cl_group[myrow],
             sorted_procs=lenv.sorted_procs[myrow][None, :],
             fq_mask=lenv.fq_mask[myrow][None],
@@ -382,7 +410,9 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             ),
         )
 
-    def send_broadcast(L: Local, myrow, tgt_mask, kind, payload, enable) -> Local:
+    def send_broadcast(
+        L: Local, myrow, tgt_mask, kind, payload, enable, zero_delay=False
+    ) -> Local:
         """Vectorized push of one message row to every process in `tgt_mask`.
 
         One send-buffer column per destination gains at most one row, so the
@@ -390,13 +420,22 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         scatters instead of n scalar pushes (compile-time hygiene: this is
         inside the hot while-loop trace). The copies share one `seq`; (src,
         seq) stays unique per receiver, preserving the deterministic order.
+
+        `zero_delay` models engine state that is globally visible at the
+        emission instant (the lockstep engine's shared command table):
+        delivery at `now`, before any same-instant protocol message
+        (`deliverables` orders command records first).
         """
         dsts = jnp.arange(n, dtype=jnp.int32)
         en = enable & (bit(tgt_mask, dsts) == 1)  # [n]
         slot = L.s_cnt
         ok = en & (slot < SB)
         tgt = jnp.where(ok, slot, SB)
-        time = L.st.now + lenv.dist_pp[myrow]
+        time = (
+            jnp.broadcast_to(L.st.now, (n,))
+            if zero_delay
+            else L.st.now + lenv.dist_pp[myrow]
+        )
         seq = L.st.send_seq[0]
         return L._replace(
             s_valid=L.s_valid.at[dsts, tgt].set(True, mode="drop"),
@@ -426,33 +465,24 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         return L
 
     def route_results(L: Local, myrow, res) -> Local:
-        """Executor results carry global client ids; only the coordinator
-        that owns the client completes it (the lockstep `client_proc == p`
-        filter, runner.rs:351-362), translating to its local slot."""
+        """Executor results carry global client ids; only the client's
+        connected process in this shard forwards them (the lockstep
+        `client_proc[c, shard_of[p]] == p` filter). Partials ride 0-delay
+        RK_PARTIAL messages to the client's owner device, which aggregates
+        them (AggregatePending, fantoch/src/executor/aggregate.rs) in
+        `b_partial` — same instant as the lockstep engine's in-place count."""
         MR = res.valid.shape[0]
+        myshard = lenv.shard_of[myrow]
         for i in range(MR):
             g = jnp.clip(res.client[i], 0, C_TOTAL - 1)
-            valid = res.valid[i] & (lenv.g2p[g] == myrow)
-            cslot = jnp.clip(lenv.g2s[g], 0, CM - 1)
-            got = L.st.c_got[0, cslot] + jnp.where(valid, 1, 0)
-            L = L._replace(
-                st=L.st._replace(c_got=L.st.c_got.at[0, cslot].set(got))
-            )
-            complete = valid & (got == KPC)
-            later = jnp.zeros((), jnp.bool_)
-            for j in range(i + 1, MR):
-                later = later | (
-                    res.valid[j]
-                    & (res.client[j] == res.client[i])
-                    & (res.rifl_seq[j] == res.rifl_seq[i])
-                )
+            valid = res.valid[i] & (lenv.g2conn[g, myshard] == myrow)
             L = send_push(
                 L,
-                myrow,
-                L.st.now + lenv.cl_dist_pc[myrow, cslot],
-                jnp.int32(RK_TO_CLIENT),
-                pad_payload([cslot, res.rifl_seq[i]]),
-                complete & ~later,
+                lenv.g2p[g],
+                L.st.now,
+                jnp.int32(RK_PARTIAL),
+                pad_payload([g, res.rifl_seq[i], myrow]),
+                valid,
             )
         return L
 
@@ -483,7 +513,7 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
 
         def b_submit(L):
             st = L.st
-            cslot = payload[0]
+            gcid = payload[0]  # global client id
             rifl = payload[1]
             ro = payload[2].astype(jnp.bool_)
             keys = payload[3 : 3 + KPC]
@@ -494,11 +524,7 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
                 next_seq=st.next_seq.at[0].add(jnp.where(ok, 1, 0)),
                 dropped=st.dropped.at[0].add(jnp.where(ok, 0, 1)),
                 cmd_client=st.cmd_client.at[0, flat].set(
-                    jnp.where(
-                        ok,
-                        lenv.cl_gcid[myrow, jnp.clip(cslot, 0, CM - 1)],
-                        st.cmd_client[0, flat],
-                    )
+                    jnp.where(ok, gcid, st.cmd_client[0, flat])
                 ),
                 cmd_rifl=st.cmd_rifl.at[0, flat].set(
                     jnp.where(ok, rifl, st.cmd_rifl[0, flat])
@@ -509,17 +535,20 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
                 cmd_ro=st.cmd_ro.at[0, flat].set(
                     jnp.where(ok, ro, st.cmd_ro[0, flat])
                 ),
-                c_got=st.c_got.at[0, jnp.clip(cslot, 0, CM - 1)].set(0),
             )
             L = L._replace(st=st)
-            # replicate the command record to every other process
+            # replicate the command record to every other process of every
+            # shard (forwarded submits and cross-shard dep requests read the
+            # dot's keys from the local command-table replica)
             cmd_payload = pad_payload(
-                [flat, lenv.cl_gcid[myrow, jnp.clip(cslot, 0, CM - 1)], rifl,
-                 ro.astype(jnp.int32)]
+                [flat, gcid, rifl, ro.astype(jnp.int32)]
                 + [keys[k] for k in range(KPC)]
             )
-            others = lenv.all_mask[myrow] & ~(jnp.int32(1) << myrow)
-            L = send_broadcast(L, myrow, others, jnp.int32(RK_CMD), cmd_payload, ok)
+            others = jnp.int32((1 << n) - 1) & ~(jnp.int32(1) << myrow)
+            L = send_broadcast(
+                L, myrow, others, jnp.int32(RK_CMD), cmd_payload, ok,
+                zero_delay=True,
+            )
             ctx = _ctx(L.st, local_env_view(myrow), myrow)
             pst, outbox, execout = pdef.submit(
                 ctx, L.st.proto, jnp.int32(0), flat, L.st.now
@@ -561,14 +590,24 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
                     jnp.where(more, st.now, st.c_start[0, cslot])
                 ),
                 c_done=st.c_done.at[0, cslot].set(st.c_done[0, cslot] | ~more),
+                # fresh partial-result count for the next command
+                # (AggregatePending::wait_for)
+                c_got=st.c_got.at[0, cslot].set(
+                    jnp.where(more, 0, st.c_got[0, cslot])
+                ),
             )
             L = L._replace(st=st)
             pay = pad_payload(
-                [cslot, st.c_issued[0, cslot], ro.astype(jnp.int32)]
+                [lenv.cl_gcid[myrow, cslot], st.c_issued[0, cslot],
+                 ro.astype(jnp.int32)]
                 + [keys[k] for k in range(KPC)]
             )
+            # the next submit goes to this client's connected process in the
+            # command's target shard (first key's shard)
+            tshard = keys[0] % SHARDS if SHARDS > 1 else jnp.int32(0)
             return send_push(
-                L, myrow, st.now + lenv.cl_dist_cp[myrow, cslot],
+                L, lenv.cl_conn[myrow, cslot, tshard],
+                st.now + lenv.cl_dist_cp[myrow, cslot, tshard],
                 jnp.int32(RK_SUBMIT), pay, more,
             )
 
@@ -584,6 +623,27 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
                 )
             )
 
+        def b_partial(L):
+            """Count one partial result at the client's owner; the partial
+            completing the command schedules the client's reply with the
+            emitting process's network delay (the lockstep engine's
+            `_route_results` count-then-complete, applied owner-side)."""
+            st = L.st
+            g = jnp.clip(payload[0], 0, C_TOTAL - 1)
+            rifl = payload[1]
+            emitter = jnp.clip(payload[2], 0, n - 1)
+            cslot = jnp.clip(lenv.g2s[g], 0, CM - 1)
+            got = st.c_got[0, cslot] + 1
+            L = L._replace(
+                st=st._replace(c_got=st.c_got.at[0, cslot].set(got))
+            )
+            return send_push(
+                L, myrow, L.st.now + lenv.dist_pc[emitter, g],
+                jnp.int32(RK_TO_CLIENT),
+                pad_payload([cslot, rifl]),
+                got == KPC,
+            )
+
         def b_proto(L):
             ctx = _ctx(L.st, local_env_view(myrow), myrow)
             pst, outbox, execout = pdef.handle(
@@ -596,7 +656,7 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
 
         return jax.lax.switch(
             jnp.clip(kind, 0, RK_PROTO_BASE),
-            [b_submit, b_client, b_cmd, b_proto],
+            [b_submit, b_client, b_cmd, b_partial, b_proto],
             L,
         )
 

@@ -95,17 +95,18 @@ def test_quantum_runner_matches_event_engine():
     )
 
 
-def _run_both_engines(pdef, config):
-    """Run one n=8 config under the event engine and the quantum runner;
-    returns (engine_state, runner_state) as numpy pytrees."""
-    n = config.n
+def _run_both_engines(pdef, config, wl=None):
+    """Run one 8-process config (single- or multi-shard) under the event
+    engine and the quantum runner; returns (engine_state, runner_state) as
+    numpy pytrees after asserting equal latency histograms."""
+    n = config.n * config.shard_count
     planet = Planet.new()
-    wl = Workload(1, KeyGen.conflict_pool(50, 2), 1, 8)
+    wl = wl or Workload(1, KeyGen.conflict_pool(50, 2), 1, 8)
     spec = setup.build_spec(
         config, wl, pdef, n_clients=2, n_client_groups=2,
         extra_ms=1000, max_steps=5_000_000,
     )
-    placement = setup.Placement(PROCESS_REGIONS[:n], CLIENT_REGIONS, 1)
+    placement = setup.Placement(PROCESS_REGIONS[: config.n], CLIENT_REGIONS, 1)
     env = setup.build_env(spec, config, planet, placement, wl, pdef)
 
     st = jax.jit(lockstep.make_run(spec, pdef, wl))(env)
@@ -170,6 +171,65 @@ def test_quantum_runner_matches_event_engine_caesar():
         Config(n=8, f=1, gc_interval_ms=100),
     )
     for counter in ("commit_count", "stable_count"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(rst.proto, counter)),
+            np.asarray(getattr(st.proto, counter)),
+        )
+    np.testing.assert_array_equal(
+        np.asarray(rst.exec.order_hash), np.asarray(st.exec.order_hash)
+    )
+
+
+def _run_both_engines_sharded(make_pdef, config, kpc=2, cmds=8):
+    """Two-shard config (ranks x shards == 8 devices): spanning commands
+    exercise submit forwarding, per-shard agreement, cross-shard result
+    aggregation, and (for graph protocols) executor dep requests under the
+    runner."""
+    shards = config.shard_count
+    wl = Workload(shards, KeyGen.conflict_pool(50, 2), kpc, cmds)
+    pdef = make_pdef(config.n * shards, wl.keys_per_command, shards)
+    return _run_both_engines(pdef, config, wl=wl)
+
+
+def test_quantum_runner_matches_event_engine_basic_sharded():
+    st, rst = _run_both_engines_sharded(
+        lambda n, kpc, s: basic_proto.make_protocol(n, kpc, shards=s),
+        Config(n=4, f=1, shard_count=2, gc_interval_ms=100),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(rst.proto.commit_count), np.asarray(st.proto.commit_count)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(rst.proto.gc.stable_count),
+        np.asarray(st.proto.gc.stable_count),
+    )
+
+
+def test_quantum_runner_matches_event_engine_tempo_sharded():
+    from fantoch_tpu.protocols import tempo as tempo_proto
+
+    st, rst = _run_both_engines_sharded(
+        lambda n, kpc, s: tempo_proto.make_protocol(n, kpc, shards=s),
+        Config(n=4, f=1, shard_count=2, gc_interval_ms=100),
+    )
+    for counter in ("commit_count", "fast_count", "slow_count"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(rst.proto, counter)),
+            np.asarray(getattr(st.proto, counter)),
+        )
+
+
+def test_quantum_runner_matches_event_engine_atlas_sharded():
+    from fantoch_tpu.protocols import atlas as atlas_proto
+
+    st, rst = _run_both_engines_sharded(
+        lambda n, kpc, s: atlas_proto.make_protocol(n, kpc, shards=s),
+        Config(
+            n=4, f=1, shard_count=2, gc_interval_ms=100,
+            executor_executed_notification_interval_ms=10,
+        ),
+    )
+    for counter in ("commit_count", "fast_count", "slow_count"):
         np.testing.assert_array_equal(
             np.asarray(getattr(rst.proto, counter)),
             np.asarray(getattr(st.proto, counter)),
