@@ -87,7 +87,8 @@ class GraphExecState(NamedTuple):
 
 
 def make_executor(
-    n: int, max_deps: int, shards: int = 1, exec_log: bool = False
+    n: int, max_deps: int, shards: int = 1, exec_log: bool = False,
+    execute_at_commit: bool = False,
 ) -> ExecutorDef:
     # under partial replication a dot can be re-delivered (MDEPREPLY
     # re-requests), so the arrival log would hold duplicates whose per-arrival
@@ -225,6 +226,35 @@ def make_executor(
                     dot + 1, mode="drop"
                 ),
                 log_len=est.log_len.at[p].add(1),
+            )
+        if execute_at_commit:
+            # bypass the dependency graph and execute on arrival
+            # (Config::execute_at_commit, graph/executor.rs:72-76); `fresh`
+            # guards against re-delivered dots (MDEPREPLY under partial
+            # replication) double-executing
+            KPC = ctx.spec.keys_per_command
+            fresh = ~est.executed[p, dot]
+            client = ctx.cmds.client[dot]
+            rifl = ctx.cmds.rifl_seq[dot]
+            kvs, ready = est.kvs, est.ready
+            for k in range(KPC):
+                key = ctx.cmds.keys[dot, k]
+                owned = fresh & (
+                    jnp.bool_(True)
+                    if shards == 1
+                    else key_shard(key, shards) == ctx.env.shard_of[ctx.pid]
+                )
+                kvs = kvs.at[p, key].set(
+                    jnp.where(owned, writer_id(client, rifl), kvs[p, key])
+                )
+                ready = ready_push(ready, p, client, rifl, enable=owned)
+            return est._replace(
+                kvs=kvs,
+                ready=ready,
+                executed=est.executed.at[p, dot].set(True),
+                executed_count=est.executed_count.at[p].add(
+                    fresh.astype(jnp.int32)
+                ),
             )
         return _try_execute(ctx, est, p, now)
 

@@ -51,7 +51,7 @@ class PredExecState(NamedTuple):
     ready: ReadyRing
 
 
-def make_executor(n: int, max_seq: int) -> ExecutorDef:
+def make_executor(n: int, max_seq: int, execute_at_commit: bool = False) -> ExecutorDef:
     DOTS = n * max_seq
     BW = bm_words(DOTS)
     EW = 2 + BW
@@ -126,6 +126,23 @@ def make_executor(n: int, max_seq: int) -> ExecutorDef:
                 jnp.where(est.committed[p, dot], est.recv_ms[p, dot], now)
             ),
         )
+        if execute_at_commit:
+            # bypass predecessor ordering (Config::execute_at_commit,
+            # pred/mod.rs:128-131)
+            KPC = ctx.spec.keys_per_command
+            client = ctx.cmds.client[dot]
+            rifl = ctx.cmds.rifl_seq[dot]
+            kvs, ring = est.kvs, est.ready
+            for k in range(KPC):
+                key = ctx.cmds.keys[dot, k]
+                kvs = kvs.at[p, key].set(writer_id(client, rifl))
+                ring = ready_push(ring, p, client, rifl)
+            return est._replace(
+                kvs=kvs,
+                ready=ring,
+                executed=est.executed.at[p, dot].set(True),
+                executed_count=est.executed_count.at[p].add(1),
+            )
         return _try_execute(ctx, est, p, now)
 
     def drain(ctx, est: PredExecState, p):

@@ -30,7 +30,11 @@ class SlotExecState(NamedTuple):
     ready: ReadyRing
 
 
-def make_executor(n: int) -> ExecutorDef:
+def make_executor(n: int, execute_at_commit: bool = False) -> ExecutorDef:
+    """`execute_at_commit` skips the slot ordering entirely and executes a
+    command the moment its `MChosen` arrives (`Config::execute_at_commit`,
+    `slot.rs:57-60`) — an evaluation knob trading order for latency."""
+
     def init(spec, env):
         SLOTS = spec.dots
         return SlotExecState(
@@ -44,6 +48,15 @@ def make_executor(n: int) -> ExecutorDef:
         KPC = ctx.spec.keys_per_command
         SLOTS = est.buf_dot.shape[1]
         slot, dot = info[0], info[1]
+        if execute_at_commit:
+            client = ctx.cmds.client[dot]
+            rifl = ctx.cmds.rifl_seq[dot]
+            kvs, ready = est.kvs, est.ready
+            for k in range(KPC):
+                key = ctx.cmds.keys[dot, k]
+                kvs = kvs.at[p, key].set(writer_id(client, rifl))
+                ready = ready_push(ready, p, client, rifl)
+            return est._replace(kvs=kvs, ready=ready)
         est = est._replace(buf_dot=est.buf_dot.at[p, slot - 1].set(dot))
 
         # try_next_slot: execute the contiguous prefix (slot.rs:89-96)

@@ -108,7 +108,7 @@ class AtlasState(NamedTuple):
 
 def _make(
     variant: str, n: int, keys_per_command: int, nfr: bool, shards: int = 1,
-    exec_log: bool = False,
+    exec_log: bool = False, execute_at_commit: bool = False,
 ) -> ProtocolDef:
     assert variant in ("atlas", "epaxos", "janus")
     KPC = keys_per_command
@@ -121,7 +121,9 @@ def _make(
     MAX_OUT = 1 if shards == 1 else max(shards + 1, 3)
     MAX_EXEC = 1
     N_KINDS = 6 if shards == 1 else 11
-    exdef = graph_executor.make_executor(n, D, shards, exec_log=exec_log)
+    exdef = graph_executor.make_executor(
+        n, D, shards, exec_log=exec_log, execute_at_commit=execute_at_commit
+    )
     EW = exdef.exec_width
 
     def init(spec, env):
@@ -570,13 +572,15 @@ def _make(
 
 def make_protocol(
     n: int, keys_per_command: int = 1, nfr: bool = False, shards: int = 1,
-    exec_log: bool = False,
+    exec_log: bool = False, execute_at_commit: bool = False,
 ) -> ProtocolDef:
-    return _make("atlas", n, keys_per_command, nfr, shards, exec_log)
+    return _make("atlas", n, keys_per_command, nfr, shards, exec_log,
+                 execute_at_commit)
 
 
 def make_janus(
     n: int, keys_per_command: int = 1, nfr: bool = False, shards: int = 1,
-    exec_log: bool = False,
+    exec_log: bool = False, execute_at_commit: bool = False,
 ) -> ProtocolDef:
-    return _make("janus", n, keys_per_command, nfr, shards, exec_log)
+    return _make("janus", n, keys_per_command, nfr, shards, exec_log,
+                 execute_at_commit)

@@ -135,6 +135,7 @@ def make_protocol(
     keys_per_command: int,
     max_seq: int,
     wait_condition: bool = True,
+    execute_at_commit: bool = False,
 ) -> ProtocolDef:
     """Build the Caesar ProtocolDef.
 
@@ -149,7 +150,7 @@ def make_protocol(
     MSG_W = 3 + BW
     MAX_OUT = 3
     MAX_EXEC = 1
-    exdef = pred_executor.make_executor(n, max_seq)
+    exdef = pred_executor.make_executor(n, max_seq, execute_at_commit=execute_at_commit)
     EW = exdef.exec_width
 
     def init(spec, env):

@@ -15,6 +15,7 @@ from .atlas import _make
 
 def make_protocol(
     n: int, keys_per_command: int = 1, nfr: bool = False, shards: int = 1,
-    exec_log: bool = False,
+    exec_log: bool = False, execute_at_commit: bool = False,
 ) -> ProtocolDef:
-    return _make("epaxos", n, keys_per_command, nfr, shards, exec_log)
+    return _make("epaxos", n, keys_per_command, nfr, shards, exec_log,
+                 execute_at_commit)

@@ -81,12 +81,14 @@ class FPaxosState(NamedTuple):
     # (fpaxos.rs:168-174)
 
 
-def make_protocol(n: int, keys_per_command: int = 1) -> ProtocolDef:
+def make_protocol(
+    n: int, keys_per_command: int = 1, execute_at_commit: bool = False
+) -> ProtocolDef:
     KPC = keys_per_command
     MSG_W = 3
     MAX_OUT = 2
     MAX_EXEC = 1
-    exdef = slot_executor.make_executor(n)
+    exdef = slot_executor.make_executor(n, execute_at_commit=execute_at_commit)
     EW = exdef.exec_width
 
     def init(spec, env):

@@ -123,6 +123,7 @@ def make_protocol(
     nfr: bool = False,
     clock_bump: bool = False,
     shards: int = 1,
+    skip_fast_ack: bool = False,
 ) -> ProtocolDef:
     """Build the Tempo ProtocolDef.
 
@@ -135,11 +136,21 @@ def make_protocol(
     every other shard touched, each shard agrees on a shard-local clock for
     its own keys, shard clocks are aggregated at the dot's coordinator, and
     the max becomes every shard's commit timestamp.
+
+    `skip_fast_ack` is the reference's fq=2 bypass (`Config::skip_fast_ack`,
+    `tempo.rs:96,317,447-465`): the coordinator ships its own votes inside
+    `MCollect`; when the fast quorum is exactly {coordinator, me}, the member
+    commits directly — broadcasting `MCommit` with its proposal clock (the
+    quorum max) and both vote sets — saving the ack round trip. Single-shard
+    only, like the reference (`shard_count == 1` guards).
     """
     KPC = keys_per_command
     ranks = n // shards  # replicas per shard
     assert ranks * shards == n
-    MSG_W = max(2 + 2 * KPC * n, n, 3)
+    assert not (skip_fast_ack and shards > 1), (
+        "skip_fast_ack is a single-shard optimization (tempo.rs:317)"
+    )
+    MSG_W = max(2 + 2 * KPC * n, n, 3 + 2 * KPC)
     MAX_OUT = max(2 + KPC + (1 if shards > 1 else 0), 1 + shards)
     MAX_EXEC = KPC
     exdef = table_executor.make_executor(n)
@@ -328,9 +339,15 @@ def make_protocol(
             )
         else:
             qmask = ctx.env.fq_mask[p]
+        collect_payload = [dot, clock, qmask]
+        if skip_fast_ack:
+            # ship the coordinator's votes so an fq=2 member can commit
+            # without the ack round (tempo.rs:317-325)
+            for i in range(KPC):
+                collect_payload += [ss[i], es[i]]
         ob = outbox_row(
             empty_outbox(MAX_OUT, MSG_W), 0,
-            jnp.bool_(True), ctx.env.all_mask[p], MCOLLECT, [dot, clock, qmask],
+            jnp.bool_(True), ctx.env.all_mask[p], MCOLLECT, collect_payload,
         )
         # forward the submit to every other shard the command touches
         # (partial.rs submit_actions)
@@ -433,10 +450,38 @@ def make_protocol(
         ack_payload = [dot, clk]
         for i in range(KPC):
             ack_payload += [ss[i], es[i]]
-        ob = outbox_row(
-            empty_outbox(MAX_OUT, MSG_W), 0,
-            q_en, jnp.int32(1) << src, MCOLLECTACK, ack_payload,
-        )
+        if not skip_fast_ack:
+            ob = outbox_row(
+                empty_outbox(MAX_OUT, MSG_W), 0,
+                q_en, jnp.int32(1) << src, MCOLLECTACK, ack_payload,
+            )
+        else:
+            # fq = {coordinator, me}: bypass the ack round and commit with
+            # our proposal clock (the quorum max) plus both vote sets
+            # (tempo.rs:447-465)
+            bypass = q_en & ~from_self & (qsz == 2)
+            rsm = jnp.zeros((KPC, n), jnp.int32)
+            rem = jnp.zeros((KPC, n), jnp.int32)
+            for i in range(KPC):
+                rsm = rsm.at[i, src].set(payload[3 + 2 * i])
+                rem = rem.at[i, src].set(payload[4 + 2 * i])
+                rsm = rsm.at[i, ctx.pid].set(ss[i])
+                rem = rem.at[i, ctx.pid].set(es[i])
+            commit_payload = [dot, clk]
+            for k in range(KPC):
+                for v in range(n):
+                    commit_payload += [rsm[k, v], rem[k, v]]
+            pad = lambda vals: jnp.concatenate(
+                [jnp.stack([jnp.asarray(x, jnp.int32) for x in vals]),
+                 jnp.zeros((MSG_W - len(vals),), jnp.int32)]
+            )
+            ob = outbox_row(
+                empty_outbox(MAX_OUT, MSG_W), 0,
+                q_en,
+                jnp.where(bypass, ctx.env.all_mask[p], jnp.int32(1) << src),
+                jnp.where(bypass, MCOMMIT, MCOLLECTACK),
+                list(jnp.where(bypass, pad(commit_payload), pad(ack_payload))),
+            )
         # non-quorum member: payload only; flush a buffered commit if the
         # MCommit overtook the MCollect (tempo.rs:369-387)
         flush = is_start & ~in_q & st.bufc_valid[p, dot]

@@ -34,10 +34,12 @@ def run(
     clients_per_region: int = 2,
     keys_per_command: int = 1,
     reorder: bool = False,
+    execute_at_commit: bool = False,
     seed: int = 0,
 ):
     planet = Planet.new()
-    config = Config(n=n, f=f, gc_interval_ms=50)
+    config = Config(n=n, f=f, gc_interval_ms=50,
+                    execute_at_commit=execute_at_commit)
     workload = Workload(
         shard_count=1,
         key_gen=KeyGen.conflict_pool(conflict_rate=conflict_rate, pool_size=1),
@@ -49,7 +51,8 @@ def run(
         "janus": atlas_proto.make_janus,
         "epaxos": epaxos_proto.make_protocol,
     }[proto]
-    pdef = make(n, workload.keys_per_command)
+    pdef = make(n, workload.keys_per_command,
+                execute_at_commit=execute_at_commit)
     C = len(CLIENT_REGIONS) * clients_per_region
     spec = setup.build_spec(
         config, workload, pdef, n_clients=C, n_client_groups=len(CLIENT_REGIONS),
@@ -122,3 +125,15 @@ def test_epaxos_n5_takes_slow_paths():
     st, metrics, spec = run("epaxos", 5, 2, conflict_rate=100, seed=1)
     check(st, metrics, spec)
     assert metrics["slow"].sum() > 0, metrics["slow"]
+
+
+def test_atlas_execute_at_commit():
+    """Config::execute_at_commit (graph/executor.rs:72-76): commands apply on
+    MCommit arrival, bypassing the dependency graph. Clients complete with
+    the same commit counts (ordering guarantees are deliberately dropped)."""
+    st0, m0, spec0 = run("atlas", 3, 1)
+    st1, m1, spec1 = run("atlas", 3, 1, execute_at_commit=True)
+    np.testing.assert_array_equal(m1["commits"], m0["commits"])
+    total = spec1.n_clients * COMMANDS_PER_CLIENT
+    assert (st1.exec.executed_count == total).all()
+    assert st1.lat_cnt.sum() == st0.lat_cnt.sum()

@@ -24,16 +24,20 @@ COMMANDS_PER_CLIENT = 20
 PROCESS_REGIONS = ["asia-east1", "us-central1", "us-west1", "us-west2", "europe-west2"]
 
 
-def run(n: int, f: int, leader_id: int, clients_per_region: int = 1):
+def run(n: int, f: int, leader_id: int, clients_per_region: int = 1,
+        execute_at_commit: bool = False):
     planet = Planet.new()
-    config = Config(n=n, f=f, gc_interval_ms=50, leader=leader_id)
+    config = Config(n=n, f=f, gc_interval_ms=50, leader=leader_id,
+                    execute_at_commit=execute_at_commit)
     workload = Workload(
         shard_count=1,
         key_gen=KeyGen.conflict_pool(conflict_rate=50, pool_size=1),
         keys_per_command=1,
         commands_per_client=COMMANDS_PER_CLIENT,
     )
-    pdef = fpaxos_proto.make_protocol(n, workload.keys_per_command)
+    pdef = fpaxos_proto.make_protocol(
+        n, workload.keys_per_command, execute_at_commit=execute_at_commit
+    )
     process_regions = PROCESS_REGIONS[:n]
     client_regions = ["us-west1", "us-west2"]
     C = len(client_regions) * clients_per_region
@@ -101,3 +105,15 @@ def test_fpaxos_n5_f2():
 
 def test_fpaxos_multiple_clients():
     check(3, 1, leader_id=1, clients_per_region=3)
+
+
+def test_fpaxos_execute_at_commit():
+    """Config::execute_at_commit (slot.rs:57-60): the executor applies
+    commands the moment MChosen arrives, skipping slot order. Every client
+    completes with the same commit counts; latency must not regress."""
+    lat0, m0, *_ = run(3, 1, 1)
+    lat1, m1, *_ = run(3, 1, 1, execute_at_commit=True)
+    np.testing.assert_array_equal(m1["commits"], m0["commits"])
+    for region in lat1:
+        assert lat1[region][0] == lat0[region][0]  # same issued counts
+        assert lat1[region][1].mean() <= lat0[region][1].mean()

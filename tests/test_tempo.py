@@ -40,6 +40,7 @@ def run(
     reorder: bool = False,
     read_only_percentage: int = 0,
     nfr: bool = False,
+    skip_fast_ack: bool = False,
     seed: int = 0,
 ):
     planet = Planet.new()
@@ -50,6 +51,7 @@ def run(
         nfr=nfr,
         tempo_tiny_quorums=tiny_quorums,
         tempo_clock_bump_interval_ms=clock_bump_ms,
+        skip_fast_ack=skip_fast_ack,
     )
     workload = Workload(
         shard_count=1,
@@ -65,6 +67,7 @@ def run(
         key_space_hint=workload.key_space(C),
         nfr=nfr,
         clock_bump=clock_bump_ms is not None,
+        skip_fast_ack=skip_fast_ack,
     )
     spec = setup.build_spec(
         config, workload, pdef, n_clients=C, n_client_groups=len(CLIENT_REGIONS),
@@ -153,3 +156,21 @@ def test_tempo_n5_f2_nfr_reads_never_slow():
     slow_reads = int(metrics["slow_reads"].sum())
     assert slow > 0
     assert slow_reads == 0, slow_reads
+
+
+def test_tempo_skip_fast_ack():
+    """skip_fast_ack (tempo.rs:96,317,447-465): with tiny quorums (fq=2) the
+    fast-quorum member commits directly from the MCollect, skipping the ack
+    round. Same per-key orders and GC completeness; commits land earlier, so
+    mean latency must not regress; the bypass path records no fast/slow path
+    (the reference's bp.path is only called in handle_mcollectack)."""
+    st0, m0, spec0 = run(3, 1, tiny_quorums=True)
+    st1, m1, spec1 = run(3, 1, tiny_quorums=True, skip_fast_ack=True)
+    total = spec1.n_clients * COMMANDS_PER_CLIENT
+    assert (m1["commits"] == total).all(), m1["commits"]
+    assert (m1["stable"] == total).all()
+    assert (st1.exec.order_cnt == st1.exec.order_cnt[0]).all()
+    assert (st1.exec.order_hash == st1.exec.order_hash[0]).all()
+    lat0 = st0.lat_sum.sum() / st0.lat_cnt.sum()
+    lat1 = st1.lat_sum.sum() / st1.lat_cnt.sum()
+    assert lat1 <= lat0, (lat1, lat0)
