@@ -147,7 +147,14 @@ def build_env(
     pdef: ProtocolDef,
     seed: int = 0,
     make_distances_symmetric: bool = False,
+    link_delays: Optional[dict] = None,
 ) -> Env:
+    """`link_delays` injects artificial extra latency on process links — the
+    reference's per-address delay tasks (`fantoch/src/run/task/server/
+    delay.rs:7-40`, enabled per connect address `run/mod.rs:104`): either
+    `{global_process_index: extra_ms}` (all links of that process, the shape
+    the reference's run tests use, `run/mod.rs:712-719`) or
+    `{(src_idx, dst_idx): extra_ms}` for one directed link."""
     n = config.n  # ranks per shard
     shards = config.shard_count
     N = n * shards  # total processes; g = shard * n + rank
@@ -172,9 +179,20 @@ def build_env(
             id_to_idx[pid] = g
 
     # process-process one-way delays (region-based, shard-independent)
-    dist_pp = planet.distance_matrix_ms(
-        proc_region, proc_region, make_distances_symmetric
-    )
+    dist_pp = np.asarray(
+        planet.distance_matrix_ms(
+            proc_region, proc_region, make_distances_symmetric
+        )
+    ).copy()
+    for key, extra in (link_delays or {}).items():
+        if isinstance(key, tuple):
+            src, dst = key
+            dist_pp[src, dst] += extra
+        else:
+            # all links of one process, both directions, self excluded
+            others = np.arange(N) != key
+            dist_pp[key, others] += extra
+            dist_pp[others, key] += extra
 
     # per-process sorted order + quorum masks (within the process's shard;
     # BaseProcess::discover filters to same-shard processes for quorums)

@@ -97,3 +97,36 @@ def summarize_batch(st: SimState) -> dict:
         "latency_count": counts,
         "latency_mean_ms": mean,
     }
+
+
+def save_state(path: str, st) -> None:
+    """Checkpoint a (batched) SimState pytree to one compressed file.
+
+    The reference has no runtime checkpointing (its only persisted
+    intermediates are bote's cached searches and the experiment result
+    dirs); device sweeps are long-lived single programs, so the chunked
+    driver adds it: snapshot between chunks, `load_state` to resume."""
+    leaves, _ = jax.tree_util.tree_flatten(st)
+    np.savez_compressed(
+        path, **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    )
+
+
+def load_state(path: str, like):
+    """Restore a SimState saved by `save_state`; `like` provides the pytree
+    structure (any state of the same spec, e.g. `init(envs)`)."""
+    leaves, treedef = jax.tree_util.tree_flatten(like)
+    data = np.load(path)
+    assert len(data.files) == len(leaves), (
+        f"checkpoint has {len(data.files)} leaves, state needs {len(leaves)}"
+    )
+    loaded = []
+    for i, ref in enumerate(leaves):
+        x = data[f"leaf_{i}"]
+        ref = np.asarray(ref)
+        assert x.shape == ref.shape and x.dtype == ref.dtype, (
+            f"checkpoint leaf {i} is {x.dtype}{x.shape}, state needs "
+            f"{ref.dtype}{ref.shape} — wrong spec/batch for this checkpoint"
+        )
+        loaded.append(jnp.asarray(x))
+    return jax.tree_util.tree_unflatten(treedef, loaded)

@@ -239,6 +239,62 @@ class Search:
     def max_f(n: int) -> int:
         return min(n // 2, 2)  # `search.rs:473-476`
 
+    def _fingerprint(self) -> np.ndarray:
+        """Parameters the cached tables depend on: region list, client set,
+        and the ping matrix itself (the reference keys saved searches to
+        their parameters, search.rs save_search/get_saved_search)."""
+        tag = "|".join(self.bote.regions) + "#" + "|".join(self.clients)
+        return np.concatenate(
+            [np.frombuffer(tag.encode(), np.uint8).astype(np.int64),
+             np.asarray(self.bote.ping, np.int64).ravel()]
+        )
+
+    def save(self, path: str) -> None:
+        """Persist the computed score tables (the reference caches searches
+        to a bincode file, `search.rs:55-95` `save_search`)."""
+        arrays = {}
+        for n in self.configs:
+            arrays[f"configs_{n}"] = self.configs[n]
+            for k, v in self.stats[n].items():
+                arrays[f"stats_{n}_{k}"] = v
+        np.savez_compressed(
+            path, ns=np.asarray(self.ns), fingerprint=self._fingerprint(),
+            **arrays,
+        )
+
+    def load(self, path: str) -> bool:
+        """Restore score tables saved by `save` (`get_saved_search`); returns
+        False when the file doesn't exist or was saved with different
+        regions/clients/ping data (caller computes and saves)."""
+        import os
+
+        if not os.path.isfile(path):
+            return False
+        data = np.load(path)
+        fp = self._fingerprint()
+        if "fingerprint" not in data.files or not np.array_equal(
+            data["fingerprint"], fp
+        ):
+            return False
+        for n in data["ns"].tolist():
+            if n not in self.ns:
+                continue
+            self.configs[n] = data[f"configs_{n}"]
+            prefix = f"stats_{n}_"
+            self.stats[n] = {
+                k[len(prefix):]: data[k]
+                for k in data.files
+                if k.startswith(prefix)
+            }
+        return all(n in self.configs for n in self.ns)
+
+    def compute_or_load(self, path: str) -> None:
+        """The reference's cached-search entry: load if saved, else compute
+        and save (`search.rs:42-62` `Search::new`)."""
+        if not self.load(path):
+            self.compute()
+            self.save(path)
+
     def compute(self) -> None:
         R = len(self.bote.regions)
         for n in self.ns:

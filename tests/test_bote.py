@@ -122,3 +122,21 @@ def test_search_small():
     # every chain is a superset chain
     for _score, (m3, m5) in chains:
         assert (m3 & m5).sum() == m3.sum()
+
+
+def test_search_save_load_roundtrip(tmp_path):
+    """Search caching (search.rs:55-95): compute_or_load computes then saves;
+    a fresh Search loads the same tables without recomputation."""
+    import numpy as np
+
+    planet_regions = ["us-west1", "us-west2", "us-central1", "us-east1", "europe-west1"]
+    bote = Bote(regions=planet_regions)
+    path = str(tmp_path / "search.npz")
+    s1 = Search(bote, ns=[3], clients=["us-west1"])
+    s1.compute_or_load(path)
+    assert 3 in s1.stats
+    s2 = Search(bote, ns=[3], clients=["us-west1"])
+    assert s2.load(path)
+    np.testing.assert_array_equal(s2.configs[3], s1.configs[3])
+    for k in s1.stats[3]:
+        np.testing.assert_array_equal(s2.stats[3][k], s1.stats[3][k])

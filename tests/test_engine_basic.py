@@ -23,7 +23,7 @@ from fantoch_tpu.protocols import basic as basic_proto
 COMMANDS_PER_CLIENT = 100
 
 
-def run(f: int, clients_per_region: int):
+def run(f: int, clients_per_region: int, link_delays=None):
     planet = Planet.new()
     config = Config(n=3, f=f, gc_interval_ms=100)
     workload = Workload(
@@ -45,7 +45,8 @@ def run(f: int, clients_per_region: int):
         client_regions=client_regions,
         clients_per_region=clients_per_region,
     )
-    env = setup.build_env(spec, config, planet, placement, workload, pdef)
+    env = setup.build_env(spec, config, planet, placement, workload, pdef,
+                          link_delays=link_delays)
     st = jax.jit(lockstep.make_run(spec, pdef, workload))(env)
     st = jax.tree_util.tree_map(np.asarray, st)
     summary.check_sim_health(st)
@@ -120,3 +121,18 @@ def test_zipf_workload_end_to_end():
     # rank-0 is the most frequent key (zipf with coefficient 1)
     counts = np.bincount(used_keys, minlength=32)
     assert counts[0] == counts.max(), counts
+
+
+def test_link_delay_injection():
+    """Per-link artificial delays (run/task/server/delay.rs analogue): extra
+    latency on one process's links shifts client latencies; a zero-delay
+    map changes nothing."""
+    lat0, m0 = run(1, 1)
+    lat1, m1 = run(1, 1, link_delays={1: 100})
+    lat2, m2 = run(1, 1, link_delays={})
+    for r in lat0:
+        assert lat2[r][1].mean() == lat0[r][1].mean()
+        assert lat1[r][1].mean() >= lat0[r][1].mean()
+    assert any(lat1[r][1].mean() > lat0[r][1].mean() for r in lat0)
+    # directed single-link form also accepted
+    run(1, 1, link_delays={(0, 1): 30})
