@@ -176,6 +176,7 @@ def run_grid(
     verbose: bool = False,
     profile_dir: Optional[str] = None,
     metrics_log: Optional[str] = None,
+    pool_slots: Optional[int] = None,
 ) -> List[str]:
     """Run every grid point and persist one results dir per shape bucket.
 
@@ -245,6 +246,10 @@ def run_grid(
                     open_loop_interval_ms=pt0.open_loop_interval_ms or None,
                     batch_max_size=pt0.batch_max_size,
                     batch_max_delay_ms=pt0.batch_max_delay_ms,
+                    # tighter in-flight bound for big sweeps (pool size is
+                    # the per-event hot-op cost; drops abort via
+                    # check_sim_health, so an undersized pool fails loudly)
+                    pool_slots=pool_slots,
                 )
             envs.append(
                 setup.build_env(

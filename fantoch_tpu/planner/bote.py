@@ -243,7 +243,11 @@ class Search:
         """Parameters the cached tables depend on: region list, client set,
         and the ping matrix itself (the reference keys saved searches to
         their parameters, search.rs save_search/get_saved_search)."""
-        tag = "|".join(self.bote.regions) + "#" + "|".join(self.clients)
+        tag = (
+            "|".join(self.bote.regions)
+            + "#" + "|".join(self.clients)
+            + "#" + "|".join(str(n) for n in self.ns)
+        )
         return np.concatenate(
             [np.frombuffer(tag.encode(), np.uint8).astype(np.int64),
              np.asarray(self.bote.ping, np.int64).ravel()]

@@ -51,14 +51,15 @@ def _i32(a) -> np.ndarray:
     return np.ascontiguousarray(np.asarray(a, dtype=np.int32))
 
 
-def sim_basic_oracle(
+def _run_oracle(
+    symbol: str,
     *,
     n: int,
     n_clients: int,
     keys_per_command: int,
     max_seq: int,
     commands_per_client: int,
-    fq_size: int,
+    protocol_args,  # ints between commands_per_client and max_res
     max_res: int,
     extra_ms: int,
     gc_interval_ms: int,
@@ -68,19 +69,23 @@ def sim_basic_oracle(
     dist_pc,
     dist_cp,
     client_proc,
-    fq_mask,
+    quorum_mask,
 ) -> dict:
-    """Run the native Basic-protocol oracle; returns per-client latency sums
-    and per-process commit/stable counters (see native/sim_oracle.cpp)."""
+    """Shared ctypes marshaling for the per-protocol oracle entry points
+    (they all take the same engine arguments around a few protocol ints and
+    fill the same output buffers)."""
     lib = load()
+    fn = getattr(lib, symbol)
+    fn.restype = ctypes.c_int
     C = n_clients
     dist_pp = _i32(dist_pp)
     dist_pc = _i32(dist_pc)
     dist_cp = _i32(dist_cp)
     client_proc = _i32(client_proc)
-    fq_mask = _i32(fq_mask)
+    quorum_mask = _i32(quorum_mask)
     assert dist_pp.shape == (n, n) and dist_pc.shape == (n, C)
-    assert dist_cp.shape == (C,) and client_proc.shape == (C,) and fq_mask.shape == (n,)
+    assert dist_cp.shape == (C,) and client_proc.shape == (C,)
+    assert quorum_mask.shape == (n,)
 
     lat_sum = np.zeros(C, np.int64)
     lat_cnt = np.zeros(C, np.int32)
@@ -91,19 +96,20 @@ def sim_basic_oracle(
     def ptr(a, t):
         return a.ctypes.data_as(ctypes.POINTER(t))
 
-    rc = lib.sim_basic(
+    rc = fn(
         n, C, keys_per_command, max_seq, commands_per_client,
-        fq_size, max_res, extra_ms, gc_interval_ms, cleanup_ms,
+        *[int(a) for a in protocol_args],
+        max_res, extra_ms, gc_interval_ms, cleanup_ms,
         ctypes.c_longlong(max_steps),
         ptr(dist_pp, ctypes.c_int32), ptr(dist_pc, ctypes.c_int32),
         ptr(dist_cp, ctypes.c_int32), ptr(client_proc, ctypes.c_int32),
-        ptr(fq_mask, ctypes.c_int32),
+        ptr(quorum_mask, ctypes.c_int32),
         ptr(lat_sum, ctypes.c_longlong), ptr(lat_cnt, ctypes.c_int32),
         ptr(commit_count, ctypes.c_int32), ptr(stable_count, ctypes.c_int32),
         ctypes.byref(steps),
     )
     if rc != 0:
-        raise RuntimeError(f"sim_basic oracle failed with code {rc}")
+        raise RuntimeError(f"{symbol} oracle failed with code {rc}")
     return {
         "lat_sum": lat_sum,
         "lat_cnt": lat_cnt,
@@ -111,3 +117,19 @@ def sim_basic_oracle(
         "stable_count": stable_count,
         "steps": int(steps.value),
     }
+
+
+def sim_basic_oracle(*, fq_size: int, fq_mask, **kw) -> dict:
+    """Run the native Basic-protocol oracle; returns per-client latency sums
+    and per-process commit/stable counters (see native/sim_oracle.cpp)."""
+    return _run_oracle(
+        "sim_basic", protocol_args=(fq_size,), quorum_mask=fq_mask, **kw
+    )
+
+
+def sim_fpaxos_oracle(*, wq_size: int, leader: int, wq_mask, **kw) -> dict:
+    """Run the native FPaxos oracle (leader-based multi-decree paxos with the
+    in-order slot executor; see native/sim_oracle.cpp `FpaxosSim`)."""
+    return _run_oracle(
+        "sim_fpaxos", protocol_args=(wq_size, leader), quorum_mask=wq_mask, **kw
+    )

@@ -307,6 +307,263 @@ struct Sim {
   }
 };
 
+// ---------------------------------------------------------------------------
+// FPaxos oracle (protocols/fpaxos.py + executors/slot.py): leader-based
+// multi-decree paxos with the in-order slot executor. Deliberately a
+// self-contained second implementation (straight-line oracle style) — only
+// Event/EventOrder are shared with the Basic oracle above.
+// ---------------------------------------------------------------------------
+
+// FPaxos message kinds (protocols/fpaxos.py)
+constexpr int FP_MFORWARD = 0;
+constexpr int FP_MACCEPT = 1;
+constexpr int FP_MACCEPTED = 2;
+constexpr int FP_MCHOSEN = 3;
+constexpr int FP_MGC = 4;
+
+struct FpaxosSim {
+  int n, C, kpc, max_seq, commands_per_client;
+  int wq_size, leader, max_res, extra_ms;
+  int64_t max_steps;
+  const int32_t* dist_pp;
+  const int32_t* dist_pc;
+  const int32_t* dist_cp;
+  const int32_t* client_proc;
+  const int32_t* wq_mask;  // [n]
+  std::vector<int64_t> per_interval;
+
+  std::priority_queue<Event, std::vector<Event>, EventOrder> pool;
+  int64_t now = 0, step = 0, seqno = 0;
+  std::vector<std::vector<int64_t>> per_next;
+  bool all_done = false;
+  int64_t final_time = INF_TIME;
+  int clients_done = 0;
+
+  std::vector<int32_t> next_seq;
+  std::vector<int32_t> cmd_client, cmd_rifl;
+  std::vector<int64_t> c_start, lat_sum;
+  std::vector<int32_t> c_issued, c_got, lat_cnt;
+  std::vector<bool> c_done;
+
+  // leader + acceptors + commanders (fpaxos.py FPaxosState)
+  std::vector<int32_t> last_slot;              // [n]
+  std::vector<bool> acc_has;                   // [n*SLOTS]
+  std::vector<int32_t> acc_dot;                // [n*SLOTS]
+  std::vector<bool> cmdr_alive;                // [n*SLOTS]
+  std::vector<int32_t> cmdr_dot, cmdr_acks;    // [n*SLOTS]
+  // commit tracking (synod/gc.rs analogue)
+  std::vector<bool> committed;                 // [n*SLOTS]
+  std::vector<int32_t> frontier;               // [n]
+  std::vector<int32_t> peer_committed;         // [n*n]
+  std::vector<bool> heard;                     // [n*n]
+  std::vector<int32_t> prev_stable, stable;    // [n]
+  std::vector<int32_t> commit_count;           // [n]
+  // slot executor (executors/slot.py)
+  std::vector<int32_t> exec_next;              // [n], 1-based
+  std::vector<int32_t> buf_dot;                // [n*SLOTS], -1 empty
+  std::vector<std::vector<std::pair<int32_t, int32_t>>> ready;
+  std::vector<size_t> ready_pop;
+
+  int slots() const { return n * max_seq; }
+
+  void push_event(int64_t time, int src, int dst, int kind,
+                  std::vector<int32_t> payload) {
+    pool.push(Event{time, seqno++, src, dst, kind, std::move(payload)});
+  }
+
+  void send_proto(int src, int32_t tgt_mask, int proto_kind,
+                  const std::vector<int32_t>& payload) {
+    for (int dst = 0; dst < n; dst++)
+      if ((tgt_mask >> dst) & 1)
+        push_event(now + dist_pp[src * n + dst], src, dst,
+                   KIND_PROTO_BASE + proto_kind, payload);
+  }
+
+  void drain_and_route(int p) {
+    int take = int(std::min<size_t>(ready[p].size() - ready_pop[p], max_res));
+    std::vector<std::pair<int32_t, int32_t>> batch;
+    for (int i = 0; i < take; i++) batch.push_back(ready[p][ready_pop[p] + i]);
+    ready_pop[p] += take;
+    if (ready_pop[p] == ready[p].size()) {
+      ready[p].clear();
+      ready_pop[p] = 0;
+    }
+    for (int i = 0; i < take; i++) {
+      int32_t c = batch[i].first, rifl = batch[i].second;
+      if (client_proc[c] != p) continue;
+      c_got[c]++;
+      bool complete = (c_got[c] == kpc);
+      bool is_last = true;
+      for (int j = i + 1; j < take; j++)
+        if (batch[j].first == c) is_last = false;
+      if (complete && is_last)
+        push_event(now + dist_pc[p * C + c], p, c, KIND_TO_CLIENT, {c, rifl});
+    }
+  }
+
+  // leader path: next slot + spawn commander + MAccept to the write quorum
+  // (fpaxos.py _leader_assign; ballots are constant b0 = leader+1)
+  void leader_assign(int p, int dot) {
+    int32_t slot = ++last_slot[p];
+    int idx = slot - 1;
+    cmdr_alive[p * slots() + idx] = true;
+    cmdr_dot[p * slots() + idx] = dot;
+    cmdr_acks[p * slots() + idx] = 0;
+    send_proto(p, wq_mask[p], FP_MACCEPT, {leader + 1, slot, dot});
+  }
+
+  void handle_submit(const Event& ev) {
+    int p = ev.dst;
+    int32_t client = ev.payload[0], rifl = ev.payload[1];
+    int32_t seq = next_seq[p];
+    if (seq > max_seq) return;
+    next_seq[p]++;
+    int dot = p * max_seq + (seq - 1);
+    cmd_client[dot] = client;
+    cmd_rifl[dot] = rifl;
+    c_got[client] = 0;
+    if (p == leader)
+      leader_assign(p, dot);
+    else
+      send_proto(p, 1 << leader, FP_MFORWARD, {dot});
+    drain_and_route(p);
+  }
+
+  void exec_chosen(int p, int32_t slot, int dot) {
+    committed[p * slots() + slot - 1] = true;
+    int32_t& fr = frontier[p];
+    while (fr < slots() && committed[p * slots() + fr]) fr++;
+    commit_count[p]++;
+    buf_dot[p * slots() + slot - 1] = dot;
+    // try_next_slot: execute the contiguous prefix (slot.rs:89-96)
+    while (exec_next[p] <= slots() &&
+           buf_dot[p * slots() + exec_next[p] - 1] >= 0) {
+      int d = buf_dot[p * slots() + exec_next[p] - 1];
+      buf_dot[p * slots() + exec_next[p] - 1] = -1;
+      exec_next[p]++;
+      for (int k = 0; k < kpc; k++)
+        ready[p].emplace_back(cmd_client[d], cmd_rifl[d]);
+    }
+  }
+
+  void handle_proto(const Event& ev) {
+    int p = ev.dst, src = ev.src;
+    int kind = ev.kind - KIND_PROTO_BASE;
+    const auto& pl = ev.payload;
+    switch (kind) {
+      case FP_MFORWARD:
+        if (p == leader) leader_assign(p, pl[0]);
+        break;
+      case FP_MACCEPT: {
+        int32_t slot = pl[1], dot = pl[2];
+        // acceptors all join the initial ballot; accept always succeeds
+        acc_has[p * slots() + slot - 1] = true;
+        acc_dot[p * slots() + slot - 1] = dot;
+        send_proto(p, 1 << src, FP_MACCEPTED, {pl[0], slot});
+        break;
+      }
+      case FP_MACCEPTED: {
+        int32_t slot = pl[1];
+        int idx = slot - 1;
+        if (cmdr_alive[p * slots() + idx] &&
+            ++cmdr_acks[p * slots() + idx] == wq_size) {
+          cmdr_alive[p * slots() + idx] = false;
+          send_proto(p, (1 << n) - 1, FP_MCHOSEN,
+                     {slot, cmdr_dot[p * slots() + idx]});
+        }
+        break;
+      }
+      case FP_MCHOSEN:
+        exec_chosen(p, pl[0], pl[1]);
+        break;
+      case FP_MGC: {
+        peer_committed[p * n + src] = pl[0];
+        heard[p * n + src] = true;
+        bool all_heard = true;
+        int32_t peer_min = INT32_MAX;
+        for (int q = 0; q < n; q++) {
+          if (q == p) continue;
+          if (!heard[p * n + q]) all_heard = false;
+          peer_min = std::min(peer_min, peer_committed[p * n + q]);
+        }
+        int32_t st = all_heard ? std::min(frontier[p], peer_min) : 0;
+        st = std::max(prev_stable[p], st);
+        // stable slots leave the acceptor state; only contacted acceptors
+        // count them (multi.rs:319-331)
+        int32_t gained = 0;
+        for (int32_t s0 = prev_stable[p]; s0 < st; s0++)
+          if (acc_has[p * slots() + s0]) {
+            acc_has[p * slots() + s0] = false;
+            gained++;
+          }
+        prev_stable[p] = st;
+        stable[p] += gained;
+        break;
+      }
+    }
+    drain_and_route(p);
+  }
+
+  void handle_to_client(const Event& ev) {
+    int32_t c = ev.payload[0];
+    lat_sum[c] += now - c_start[c];
+    lat_cnt[c]++;
+    bool more = c_issued[c] < commands_per_client;
+    if (more) {
+      push_event(now + dist_cp[c], c, client_proc[c], KIND_SUBMIT,
+                 {c, c_issued[c] + 1, 0});
+      c_issued[c]++;
+      c_start[c] = now;
+    } else if (!c_done[c]) {
+      c_done[c] = true;
+      if (++clients_done >= C) {
+        all_done = true;
+        final_time = now + extra_ms;
+      }
+    }
+  }
+
+  void periodic_fire() {
+    int bp = 0, bk = 0;
+    int64_t bt = INF_TIME + 1;
+    const int nper = int(per_interval.size());
+    for (int p = 0; p < n; p++)
+      for (int k = 0; k < nper; k++)
+        if (per_next[p][k] < bt) bt = per_next[p][k], bp = p, bk = k;
+    per_next[bp][bk] += per_interval[bk];
+    if (bk == 0) {
+      send_proto(bp, ((1 << n) - 1) & ~(1 << bp), FP_MGC, {frontier[bp]});
+    } else {
+      drain_and_route(bp);
+    }
+  }
+
+  void run() {
+    for (int c = 0; c < C; c++)
+      push_event(dist_cp[c], c, client_proc[c], KIND_SUBMIT, {c, 1, 0});
+    while (!(all_done && now > final_time) && step < max_steps &&
+           now < INF_TIME) {
+      int64_t t_pool = pool.empty() ? INF_TIME : pool.top().time;
+      int64_t t_per = INF_TIME;
+      for (auto& row : per_next)
+        for (int64_t t : row) t_per = std::min(t_per, t);
+      now = std::min(t_pool, t_per);
+      step++;
+      if (t_pool <= t_per) {
+        Event ev = pool.top();
+        pool.pop();
+        switch (ev.kind) {
+          case KIND_SUBMIT: handle_submit(ev); break;
+          case KIND_TO_CLIENT: handle_to_client(ev); break;
+          default: handle_proto(ev); break;
+        }
+      } else {
+        periodic_fire();
+      }
+    }
+  }
+};
+
 }  // namespace
 
 extern "C" {
@@ -320,7 +577,7 @@ int sim_basic(int n, int C, int kpc, int max_seq, int commands_per_client,
               const int32_t* client_proc, const int32_t* fq_mask,
               long long* lat_sum, int32_t* lat_cnt, int32_t* commit_count,
               int32_t* stable_count, long long* out_steps) {
-  if (n < 1 || n > 31 || C < 1 || kpc < 1) return 1;
+  if (n < 1 || n > 30 || C < 1 || kpc < 1) return 1;
   Sim s;
   s.n = n; s.C = C; s.kpc = kpc; s.max_seq = max_seq;
   s.commands_per_client = commands_per_client;
@@ -349,6 +606,56 @@ int sim_basic(int n, int C, int kpc, int max_seq, int commands_per_client,
   for (int p = 0; p < n; p++) {
     commit_count[p] = s.commit_count[p];
     stable_count[p] = s.gc_stable[p];
+  }
+  *out_steps = s.step;
+  return 0;
+}
+
+// FPaxos variant: leader index (0-based) + write-quorum masks instead of the
+// fast-quorum arguments.
+int sim_fpaxos(int n, int C, int kpc, int max_seq, int commands_per_client,
+               int wq_size, int leader, int max_res, int extra_ms,
+               int gc_interval_ms, int cleanup_ms, long long max_steps,
+               const int32_t* dist_pp, const int32_t* dist_pc,
+               const int32_t* dist_cp, const int32_t* client_proc,
+               const int32_t* wq_mask, long long* lat_sum, int32_t* lat_cnt,
+               int32_t* commit_count, int32_t* stable_count,
+               long long* out_steps) {
+  if (n < 1 || n > 30 || C < 1 || kpc < 1 || leader < 0 || leader >= n)
+    return 1;
+  FpaxosSim s;
+  s.n = n; s.C = C; s.kpc = kpc; s.max_seq = max_seq;
+  s.commands_per_client = commands_per_client;
+  s.wq_size = wq_size; s.leader = leader;
+  s.max_res = max_res; s.extra_ms = extra_ms;
+  s.max_steps = max_steps;
+  s.dist_pp = dist_pp; s.dist_pc = dist_pc; s.dist_cp = dist_cp;
+  s.client_proc = client_proc; s.wq_mask = wq_mask;
+  s.per_interval = {gc_interval_ms, cleanup_ms};
+  s.per_next.assign(n, {int64_t(gc_interval_ms), int64_t(cleanup_ms)});
+  int D = s.slots();
+  s.next_seq.assign(n, 1);
+  s.cmd_client.assign(D, 0); s.cmd_rifl.assign(D, 0);
+  s.c_start.assign(C, 0); s.lat_sum.assign(C, 0);
+  s.c_issued.assign(C, 1); s.c_got.assign(C, 0); s.lat_cnt.assign(C, 0);
+  s.c_done.assign(C, false);
+  s.last_slot.assign(n, 0);
+  s.acc_has.assign(n * D, false); s.acc_dot.assign(n * D, 0);
+  s.cmdr_alive.assign(n * D, false);
+  s.cmdr_dot.assign(n * D, 0); s.cmdr_acks.assign(n * D, 0);
+  s.committed.assign(n * D, false); s.frontier.assign(n, 0);
+  s.peer_committed.assign(n * n, 0); s.heard.assign(n * n, false);
+  s.prev_stable.assign(n, 0); s.stable.assign(n, 0);
+  s.commit_count.assign(n, 0);
+  s.exec_next.assign(n, 1); s.buf_dot.assign(n * D, -1);
+  s.ready.assign(n, {}); s.ready_pop.assign(n, 0);
+
+  s.run();
+
+  for (int c = 0; c < C; c++) { lat_sum[c] = s.lat_sum[c]; lat_cnt[c] = s.lat_cnt[c]; }
+  for (int p = 0; p < n; p++) {
+    commit_count[p] = s.commit_count[p];
+    stable_count[p] = s.stable[p];
   }
   *out_steps = s.step;
   return 0;

@@ -95,3 +95,79 @@ def test_engine_matches_native_oracle(n, f, pregions, cregions, cpr, cmds):
     np.testing.assert_array_equal(engine["commit_count"], oracle["commit_count"])
     np.testing.assert_array_equal(engine["stable_count"], oracle["stable_count"])
     assert engine["steps"] == oracle["steps"]
+
+
+def run_both_fpaxos(n, f, leader_id, process_regions, client_regions,
+                    clients_per_region, cmds):
+    from fantoch_tpu.protocols import fpaxos as fpaxos_proto
+    from fantoch_tpu.utils.native import sim_fpaxos_oracle
+
+    planet = Planet.new()
+    config = Config(n=n, f=f, gc_interval_ms=100, leader=leader_id)
+    workload = Workload(
+        shard_count=1,
+        key_gen=KeyGen.conflict_pool(conflict_rate=100, pool_size=1),
+        keys_per_command=1,
+        commands_per_client=cmds,
+    )
+    pdef = fpaxos_proto.make_protocol(n, 1)
+    C = len(client_regions) * clients_per_region
+    spec = setup.build_spec(
+        config, workload, pdef, n_clients=C, n_client_groups=len(client_regions),
+        extra_ms=1000, max_steps=5_000_000,
+    )
+    placement = setup.Placement(process_regions, client_regions, clients_per_region)
+    env = setup.build_env(spec, config, planet, placement, workload, pdef)
+
+    st = jax.jit(lockstep.make_run(spec, pdef, workload))(env)
+    st = jax.tree_util.tree_map(np.asarray, st)
+    summary.check_sim_health(st)
+    engine = {
+        "lat_sum": st.lat_sum.astype(np.int64),
+        "lat_cnt": st.lat_cnt,
+        "commit_count": np.asarray(st.proto.commit_count),
+        "stable_count": np.asarray(st.proto.stable_count),
+        "steps": int(st.step),
+    }
+    oracle = sim_fpaxos_oracle(
+        n=n,
+        n_clients=C,
+        keys_per_command=1,
+        max_seq=spec.max_seq,
+        commands_per_client=cmds,
+        wq_size=int(env.wq_size),
+        leader=int(env.leader),
+        max_res=spec.max_res,
+        extra_ms=spec.extra_ms,
+        gc_interval_ms=100,
+        cleanup_ms=spec.cleanup_ms,
+        max_steps=spec.max_steps,
+        dist_pp=env.dist_pp,
+        dist_pc=env.dist_pc,
+        dist_cp=env.dist_cp[:, 0],
+        client_proc=env.client_proc[:, 0],
+        wq_mask=env.wq_mask,
+    )
+    return engine, oracle
+
+
+FPAXOS_CASES = [
+    (3, 1, 1, ["asia-east1", "us-central1", "us-west1"],
+     ["us-west1", "us-west2"], 1, 20),
+    (5, 2, 3, ["asia-east1", "us-central1", "us-west1", "europe-west2",
+               "europe-west3"], ["us-west1", "europe-west2"], 2, 10),
+]
+
+
+@pytest.mark.parametrize("n,f,leader,pregions,cregions,cpr,cmds", FPAXOS_CASES)
+def test_engine_matches_native_oracle_fpaxos(n, f, leader, pregions, cregions,
+                                             cpr, cmds):
+    """The second protocol through the native oracle: leader-based FPaxos
+    with the slot executor must agree exactly with the device engine on
+    latencies, commit/stable counters, and step counts."""
+    engine, oracle = run_both_fpaxos(n, f, leader, pregions, cregions, cpr, cmds)
+    np.testing.assert_array_equal(engine["lat_cnt"], oracle["lat_cnt"])
+    np.testing.assert_array_equal(engine["lat_sum"], oracle["lat_sum"])
+    np.testing.assert_array_equal(engine["commit_count"], oracle["commit_count"])
+    np.testing.assert_array_equal(engine["stable_count"], oracle["stable_count"])
+    assert engine["steps"] == oracle["steps"]

@@ -1,0 +1,138 @@
+"""North-star run: an EuroSys'21-style latency/throughput sweep on device.
+
+BASELINE.md's target for this framework: sweep thousands of
+(protocol, n, f, conflict, placement) configurations per chip-hour and
+reproduce the reference evaluation's latency-vs-throughput curves
+(`README.md:29-38` + `plot.png`; sweep shape `fantoch_ps/src/bin/
+simulation.rs:140-216`). This driver runs the grid through the experiment
+harness (shape-bucketed, chunked device calls), renders the headline
+figures, and prints one JSON line with configs-swept/hour.
+
+    python tools/northstar.py --out northstar_results [--scale 2]
+
+Scale 1 is sized for a quick single-chip demonstration (~200 configs in a
+few minutes); raise --scale (or run on more chips with --mesh) for the full
+10k-config target.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+PLACEMENTS = {
+    "gcp_apac_us": ["asia-east1", "us-central1", "us-west1", "europe-west2",
+                    "europe-west3"],
+    "gcp_us_eu": ["us-east1", "us-west1", "europe-west1", "europe-west4",
+                  "us-central1"],
+}
+CLIENT_REGIONS = ["us-west1", "europe-west2"]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="northstar_results")
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--n", type=int, default=3)
+    ap.add_argument("--commands", type=int, default=20)
+    ap.add_argument("--chunk-steps", type=int, default=1500)
+    ap.add_argument("--mesh", action="store_true",
+                    help="shard the batch over all devices")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    # persistent compilation cache: identical shape buckets (e.g. the second
+    # placement's) load compiled programs from disk instead of recompiling
+    cache = os.path.join(args.out, ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from fantoch_tpu.exp.harness import Point, run_grid
+    from fantoch_tpu.plot.db import ResultsDB
+    from fantoch_tpu.plot import plots
+
+    protocols = ["tempo", "atlas", "epaxos"]
+    conflicts = [0, 2, 10, 50, 100]
+    # wide seed axis: every (protocol, clients) shape bucket holds
+    # conflicts x seeds configs, so one compile amortizes over the batch
+    seeds = range(max(1, int(8 * args.scale)))
+    client_counts = [2, 4]
+
+    points = []
+    for proto in protocols:
+        for conflict in conflicts:
+            for clients in client_counts:
+                for seed in seeds:
+                    points.append(
+                        Point(
+                            protocol=proto, n=args.n, f=1,
+                            clients_per_region=clients,
+                            conflict_rate=conflict, pool_size=1,
+                            commands_per_client=args.commands, seed=seed,
+                        )
+                    )
+
+    mesh = None
+    if args.mesh:
+        import jax
+        import numpy as np
+
+        mesh = jax.sharding.Mesh(np.array(jax.devices()), ("configs",))
+
+    results_root = os.path.join(args.out, "results")
+    t0 = time.time()
+    for pname, regions in PLACEMENTS.items():
+        run_grid(
+            points,
+            process_regions=regions[: args.n],
+            client_regions=CLIENT_REGIONS,
+            results_root=results_root,
+            name=f"northstar_{pname}",
+            chunk_steps=args.chunk_steps,
+            mesh=mesh,
+            pool_slots=256,
+        )
+    wall = time.time() - t0
+    total = len(points) * len(PLACEMENTS)
+
+    db = ResultsDB.load(results_root)
+    series = {p: db.find(protocol=p) for p in protocols}
+    figdir = os.path.join(args.out, "figures")
+    os.makedirs(figdir, exist_ok=True)
+    figures = [
+        plots.throughput_latency_plot(
+            series, os.path.join(figdir, "throughput_latency.png")
+        ),
+        plots.throughput_latency_plot(
+            series, os.path.join(figdir, "throughput_p99.png"), latency="p99"
+        ),
+        plots.fast_path_plot(
+            series, "conflict", os.path.join(figdir, "fast_path.png")
+        ),
+        plots.cdf_plot(
+            [e for p in protocols for e in db.find(protocol=p, conflict=50,
+                                                  clients=2, seed=0)][:12],
+            os.path.join(figdir, "cdf.png"),
+        ),
+    ]
+    print(plots.dstat_table(results_root), file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": "configs swept/hour/chip (EuroSys'21-style grid)",
+                "configs": total,
+                "wall_s": round(wall, 1),
+                "value": round(total / wall * 3600.0, 1),
+                "unit": "configs/hour",
+                "figures": figures,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
