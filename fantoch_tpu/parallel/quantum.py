@@ -39,7 +39,8 @@ reply with the completing emitter's network delay — the same count-then-
 complete discipline as the engine's `_route_results`.
 
 Constraints: `n == mesh axis size` (one process per device slice, n = ranks
-x shards); closed-loop clients.
+x shards); open- or closed-loop clients (client-side batching stays an
+event-engine mode).
 
 Known boundary difference vs the event engine: the engine's loop guard reads
 the previous event's time, so it processes exactly one event past
@@ -72,15 +73,17 @@ from ..engine.types import (
     bit,
 )
 
-# runner-local message kinds: the lock-step engine reserves {0,1} and puts
-# protocol kinds at 2+; the runner inserts the command-record kind at 2 and
-# the client partial-result kind at 3, shifting protocol kinds to 4+
+# runner-local message kinds: the lock-step engine reserves {0: submit,
+# 1: to-client, 2: tick} and puts protocol kinds at 3+; the runner keeps
+# {0, 1}, inserts the command-record kind at 2 and the client partial-result
+# kind at 3, moves the tick to 4, and shifts protocol kinds to 5+
 # (translated back before pdef.handle)
 RK_SUBMIT = KIND_SUBMIT  # 0
 RK_TO_CLIENT = KIND_TO_CLIENT  # 1
 RK_CMD = 2
 RK_PARTIAL = 3
-RK_PROTO_BASE = 4
+RK_TICK = 4
+RK_PROTO_BASE = 5
 
 AXIS = "procs"
 
@@ -140,8 +143,10 @@ class RState(NamedTuple):
     # clients [n, CM]
     c_start: jnp.ndarray
     c_issued: jnp.ndarray
+    c_resp: jnp.ndarray  # [n, CM] completed commands (open loop)
+    c_sub_time: jnp.ndarray  # [n, CM, CT] per-command issue time (open loop)
     c_done: jnp.ndarray
-    c_got: jnp.ndarray
+    c_got: jnp.ndarray  # [n, CM, CT] partial counts per outstanding rifl
     lat_sum: jnp.ndarray
     lat_cnt: jnp.ndarray
     hist: jnp.ndarray  # [n, G, NB]
@@ -171,11 +176,13 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
     `env` is the standard single-config Env from engine/setup.py;
     `run_sharded(mesh, state)` requires mesh size == n.
     """
-    assert spec.open_loop_interval_ms is None, (
-        "the distributed runner supports closed-loop clients only"
-    )
     assert not spec.reorder, "message reordering is an event-engine mode"
-    assert spec.batch_max_size <= 1, "batching needs open-loop clients"
+    assert spec.batch_max_size <= 1, (
+        "the distributed runner does not batch (client-side batching is an"
+        " event-engine mode)"
+    )
+    OPEN = spec.open_loop_interval_ms is not None
+    CT = spec.commands_per_client if OPEN else 1
     n, C_TOTAL, S = spec.n, spec.n_clients, spec.pool_slots
     SHARDS = spec.shards
     W = max(message_width(pdef, spec.keys_per_command), 4 + spec.keys_per_command)
@@ -282,8 +289,21 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         dist_cp = np.asarray(env.dist_cp)
         fill = [0] * n
         for c in range(C_TOTAL):
-            # the first submit goes to the client's connected process in the
-            # first command's target shard (first key's, workload.rs:154-185)
+            if OPEN:
+                # open loop: the first interval tick fires at the owner at
+                # t=0 (lockstep.py init_state OPEN path)
+                p = int(g2p_np[c])
+                s = fill[p]
+                fill[p] += 1
+                iv[p, s] = True
+                it[p, s] = 0
+                isq[p, s] = s
+                ik[p, s] = RK_TICK
+                ipay[p, s, 0] = int(g2s_np[c])  # local client slot
+                continue
+            # closed loop: the first submit goes to the client's connected
+            # process in the first command's target shard (first key's,
+            # workload.rs:154-185)
             t = int(keys0[c, 0]) % SHARDS
             p = int(client_proc[c, t])
             s = fill[p]
@@ -316,9 +336,15 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             cmd_keys=jnp.zeros((n, DOTS, KPC), jnp.int32),
             cmd_ro=jnp.zeros((n, DOTS), jnp.bool_),
             c_start=jnp.zeros((n, CM), jnp.int32),
-            c_issued=jnp.where(jnp.asarray(cl_present), 1, 0).astype(jnp.int32),
+            c_issued=(
+                jnp.zeros((n, CM), jnp.int32)
+                if OPEN
+                else jnp.where(jnp.asarray(cl_present), 1, 0).astype(jnp.int32)
+            ),
+            c_resp=jnp.zeros((n, CM), jnp.int32),
+            c_sub_time=jnp.zeros((n, CM, CT), jnp.int32),
             c_done=jnp.zeros((n, CM), jnp.bool_),
-            c_got=jnp.zeros((n, CM), jnp.int32),
+            c_got=jnp.zeros((n, CM, CT), jnp.int32),
             lat_sum=jnp.zeros((n, CM), jnp.int32),
             lat_cnt=jnp.zeros((n, CM), jnp.int32),
             hist=jnp.zeros((n, G, NB), jnp.int32),
@@ -565,7 +591,14 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         def b_client(L):
             st = L.st
             cslot = jnp.clip(payload[0], 0, CM - 1)
-            lat = st.now - st.c_start[0, cslot]
+            # latency recording (_record_latency, lockstep.py:401): open
+            # loop keys the submit time by the completed rifl, closed loop
+            # by the single outstanding command
+            if OPEN:
+                rslot = jnp.clip(payload[1] - 1, 0, CT - 1)
+                lat = st.now - st.c_sub_time[0, cslot, rslot]
+            else:
+                lat = st.now - st.c_start[0, cslot]
             g = lenv.cl_group[myrow, cslot]
             st = st._replace(
                 hist=st.hist.at[0, g, jnp.clip(lat, 0, NB - 1)].add(1),
@@ -575,6 +608,20 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
                 lat_sum=st.lat_sum.at[0, cslot].add(lat),
                 lat_cnt=st.lat_cnt.at[0, cslot].add(1),
             )
+            if OPEN:
+                # completion counted separately from issuance
+                # (lockstep.py _client_branch OPEN path)
+                resp = st.c_resp[0, cslot] + 1
+                newly_done = (
+                    (resp >= spec.commands_per_client) & ~st.c_done[0, cslot]
+                )
+                st = st._replace(
+                    c_resp=st.c_resp.at[0, cslot].set(resp),
+                    c_done=st.c_done.at[0, cslot].set(
+                        st.c_done[0, cslot] | newly_done
+                    ),
+                )
+                return L._replace(st=st)
             more = st.c_issued[0, cslot] < spec.commands_per_client
             keys, ro = workload_mod.sample_command_keys(
                 consts,
@@ -591,9 +638,9 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
                 ),
                 c_done=st.c_done.at[0, cslot].set(st.c_done[0, cslot] | ~more),
                 # fresh partial-result count for the next command
-                # (AggregatePending::wait_for)
-                c_got=st.c_got.at[0, cslot].set(
-                    jnp.where(more, 0, st.c_got[0, cslot])
+                # (AggregatePending::wait_for; closed loop reuses slot 0)
+                c_got=st.c_got.at[0, cslot, 0].set(
+                    jnp.where(more, 0, st.c_got[0, cslot, 0])
                 ),
             )
             L = L._replace(st=st)
@@ -633,15 +680,57 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             rifl = payload[1]
             emitter = jnp.clip(payload[2], 0, n - 1)
             cslot = jnp.clip(lenv.g2s[g], 0, CM - 1)
-            got = st.c_got[0, cslot] + 1
+            rslot = jnp.clip(rifl - 1, 0, CT - 1)
+            got = st.c_got[0, cslot, rslot] + 1
             L = L._replace(
-                st=st._replace(c_got=st.c_got.at[0, cslot].set(got))
+                st=st._replace(c_got=st.c_got.at[0, cslot, rslot].set(got))
             )
             return send_push(
                 L, myrow, L.st.now + lenv.dist_pc[emitter, g],
                 jnp.int32(RK_TO_CLIENT),
                 pad_payload([cslot, rifl]),
                 got == KPC,
+            )
+
+        def b_tick(L):
+            """Open-loop interval tick at the client's owner: issue the
+            next command toward its target shard's connected process and
+            schedule the following tick (lockstep.py _tick_branch, B=1)."""
+            st = L.st
+            cslot = jnp.clip(payload[0], 0, CM - 1)
+            i = st.c_issued[0, cslot]
+            more = i < spec.commands_per_client
+            keys, ro = workload_mod.sample_command_keys(
+                consts,
+                jax.random.wrap_key_data(lenv.seed),
+                lenv.cl_gcid[myrow, cslot],
+                i,
+                lenv.conflict_rate,
+                lenv.read_only_pct,
+            )
+            slot = jnp.clip(i, 0, CT - 1)
+            st = st._replace(
+                c_sub_time=st.c_sub_time.at[0, cslot, slot].set(
+                    jnp.where(more, st.now, st.c_sub_time[0, cslot, slot])
+                ),
+                c_issued=st.c_issued.at[0, cslot].add(more.astype(jnp.int32)),
+            )
+            L = L._replace(st=st)
+            pay = pad_payload(
+                [lenv.cl_gcid[myrow, cslot], i + 1, ro.astype(jnp.int32)]
+                + [keys[k] for k in range(KPC)]
+            )
+            tshard = keys[0] % SHARDS if SHARDS > 1 else jnp.int32(0)
+            L = send_push(
+                L, lenv.cl_conn[myrow, cslot, tshard],
+                st.now + lenv.cl_dist_cp[myrow, cslot, tshard],
+                jnp.int32(RK_SUBMIT), pay, more,
+            )
+            interval = spec.open_loop_interval_ms or 1
+            return send_push(
+                L, myrow, st.now + interval, jnp.int32(RK_TICK),
+                pad_payload([cslot]),
+                more & ((i + 1) < spec.commands_per_client),
             )
 
         def b_proto(L):
@@ -656,7 +745,7 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
 
         return jax.lax.switch(
             jnp.clip(kind, 0, RK_PROTO_BASE),
-            [b_submit, b_client, b_cmd, b_partial, b_proto],
+            [b_submit, b_client, b_cmd, b_partial, b_tick, b_proto],
             L,
         )
 
