@@ -56,6 +56,12 @@ def cmd_sim(args) -> int:
         open_loop_interval_ms=args.open_loop,
         batch_max_size=args.batch,
         batch_max_delay_ms=args.batch_delay,
+        nfr=args.nfr,
+        tempo_tiny_quorums=args.tiny_quorums,
+        tempo_clock_bump_interval_ms=args.clock_bump,
+        skip_fast_ack=args.skip_fast_ack,
+        execute_at_commit=args.execute_at_commit,
+        caesar_wait_condition=not args.no_wait_condition,
     )
     dirs = run_grid(
         [pt],
@@ -325,6 +331,14 @@ def main(argv=None) -> int:
     ps.add_argument("--conflict", type=int, default=0)
     ps.add_argument("--key-gen", choices=["conflict_pool", "zipf"],
                     default="conflict_pool")
+    ps.add_argument("--nfr", action="store_true")
+    ps.add_argument("--tiny-quorums", action="store_true")
+    ps.add_argument("--clock-bump", type=int, default=0,
+                    help="tempo clock-bump interval ms (0 = off)")
+    ps.add_argument("--skip-fast-ack", action="store_true")
+    ps.add_argument("--execute-at-commit", action="store_true")
+    ps.add_argument("--no-wait-condition", action="store_true",
+                    help="disable caesar_wait_condition")
     ps.add_argument("--zipf-coefficient", type=float, default=1.0)
     ps.add_argument("--zipf-keys", type=int, default=64)
     ps.add_argument("--keys-per-command", type=int, default=1)

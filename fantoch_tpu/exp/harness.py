@@ -87,6 +87,14 @@ class Point:
     open_loop_interval_ms: int = 0
     batch_max_size: int = 1
     batch_max_delay_ms: int = 0
+    # protocol flags (Config + factory knobs; bin/common/protocol.rs exposes
+    # the same set on the reference's CLIs)
+    nfr: bool = False
+    tempo_tiny_quorums: bool = False
+    tempo_clock_bump_interval_ms: int = 0
+    skip_fast_ack: bool = False
+    execute_at_commit: bool = False
+    caesar_wait_condition: bool = True
 
     def search(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)
@@ -118,27 +126,44 @@ def make_protocol_def(
     key_space_hint: int = 0,
     nfr: bool = False,
     wait_condition: bool = True,
+    clock_bump: bool = False,
+    tiny_quorums: bool = False,
+    skip_fast_ack: bool = False,
+    execute_at_commit: bool = False,
 ) -> ProtocolDef:
     """Dispatch to the per-protocol constructors (the analogue of the
-    per-protocol server binaries, `fantoch_ps/src/bin/*.rs`)."""
+    per-protocol server binaries, `fantoch_ps/src/bin/*.rs`). `tiny_quorums`
+    only shapes quorum sizes through Config; it is accepted here so callers
+    can pass one flag set for both Config and factory."""
+    del tiny_quorums  # quorum sizing lives in Config (config.py)
     if name == "basic":
         return basic_proto.make_protocol(n, keys_per_command)
     if name == "tempo":
         return tempo_proto.make_protocol(
-            n, keys_per_command, key_space_hint=key_space_hint, nfr=nfr
+            n, keys_per_command, key_space_hint=key_space_hint, nfr=nfr,
+            clock_bump=clock_bump, skip_fast_ack=skip_fast_ack,
         )
     if name == "atlas":
-        return atlas_proto.make_protocol(n, keys_per_command, nfr=nfr)
+        return atlas_proto.make_protocol(
+            n, keys_per_command, nfr=nfr, execute_at_commit=execute_at_commit
+        )
     if name == "janus":
-        return atlas_proto.make_janus(n, keys_per_command, nfr=nfr)
+        return atlas_proto.make_janus(
+            n, keys_per_command, nfr=nfr, execute_at_commit=execute_at_commit
+        )
     if name == "epaxos":
-        return epaxos_proto.make_protocol(n, keys_per_command, nfr=nfr)
+        return epaxos_proto.make_protocol(
+            n, keys_per_command, nfr=nfr, execute_at_commit=execute_at_commit
+        )
     if name == "fpaxos":
-        return fpaxos_proto.make_protocol(n, keys_per_command)
+        return fpaxos_proto.make_protocol(
+            n, keys_per_command, execute_at_commit=execute_at_commit
+        )
     if name == "caesar":
         assert max_seq is not None, "caesar sizes dep bitmaps by max_seq"
         return caesar_proto.make_protocol(
-            n, keys_per_command, max_seq, wait_condition=wait_condition
+            n, keys_per_command, max_seq, wait_condition=wait_condition,
+            execute_at_commit=execute_at_commit,
         )
     raise ValueError(f"unknown protocol {name!r}; have {PROTOCOLS}")
 
@@ -157,6 +182,12 @@ def _bucket_key(pt: Point) -> Tuple:
         pt.open_loop_interval_ms,
         pt.batch_max_size,
         pt.batch_max_delay_ms,
+        pt.nfr,
+        pt.tempo_tiny_quorums,
+        pt.tempo_clock_bump_interval_ms,
+        pt.skip_fast_ack,
+        pt.execute_at_commit,
+        pt.caesar_wait_condition,
     )
 
 
@@ -222,6 +253,11 @@ def run_grid(
             setup.command_key_slots(wl, pt0.batch_max_size),
             max_seq=max_seq,
             key_space_hint=wl.key_space(C),
+            nfr=pt0.nfr,
+            wait_condition=pt0.caesar_wait_condition,
+            clock_bump=pt0.tempo_clock_bump_interval_ms > 0,
+            skip_fast_ack=pt0.skip_fast_ack,
+            execute_at_commit=pt0.execute_at_commit,
         )
         leader = 1 if not pdef.leaderless else None
         placement = setup.Placement(pregions, client_regions, pt0.clients_per_region)
@@ -231,7 +267,15 @@ def run_grid(
         spec = None
         for pt in bpoints:
             config = Config(
-                n=n, f=pt.f, gc_interval_ms=gc_interval_ms, leader=leader
+                n=n, f=pt.f, gc_interval_ms=gc_interval_ms, leader=leader,
+                nfr=pt.nfr,
+                tempo_tiny_quorums=pt.tempo_tiny_quorums,
+                tempo_clock_bump_interval_ms=(
+                    pt.tempo_clock_bump_interval_ms or None
+                ),
+                skip_fast_ack=pt.skip_fast_ack,
+                execute_at_commit=pt.execute_at_commit,
+                caesar_wait_condition=pt.caesar_wait_condition,
             )
             if spec is None:
                 spec = setup.build_spec(

@@ -87,3 +87,29 @@ def test_cli_sequencer_bench(capsys):
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["proposals"] == 8 * 64
     assert out["proposals_per_sec"] > 0
+
+
+def test_cli_protocol_flags(capsys, tmp_path):
+    """The sim CLI exposes the reference's protocol flags
+    (bin/common/protocol.rs): drive tempo with tiny quorums + skip_fast_ack
+    and caesar with the wait condition disabled, end to end."""
+    d = str(tmp_path)
+    rc = main([
+        "sim", "--protocol", "tempo", "--n", "3", "--f", "1",
+        "--conflict", "100", "--commands", "5", "--clients", "1",
+        "--tiny-quorums", "--skip-fast-ack", "--results", f"{d}/r1",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.splitlines()[0])
+    assert out["skip_fast_ack"] and out["tempo_tiny_quorums"]
+    assert out["count"] == 10
+
+    rc = main([
+        "sim", "--protocol", "caesar", "--n", "3", "--f", "1",
+        "--conflict", "50", "--commands", "5", "--clients", "1",
+        "--no-wait-condition", "--execute-at-commit", "--results", f"{d}/r2",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.splitlines()[0])
+    assert out["execute_at_commit"] and not out["caesar_wait_condition"]
+    assert out["count"] == 10
