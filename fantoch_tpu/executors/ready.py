@@ -36,7 +36,7 @@ class ReadyRing(NamedTuple):
     rifl_seq: jnp.ndarray  # [n, RQ] int32
     push: jnp.ndarray  # [n] int32 total pushed
     pop: jnp.ndarray  # [n] int32 total popped
-    overflow: jnp.ndarray  # int32 pushes lost to a full ring (must stay 0)
+    overflow: jnp.ndarray  # [n] int32 pushes lost to a full ring (must stay 0)
 
 
 def ready_init(n: int, capacity: int) -> ReadyRing:
@@ -45,7 +45,7 @@ def ready_init(n: int, capacity: int) -> ReadyRing:
         rifl_seq=jnp.zeros((n, capacity), jnp.int32),
         push=jnp.zeros((n,), jnp.int32),
         pop=jnp.zeros((n,), jnp.int32),
-        overflow=jnp.int32(0),
+        overflow=jnp.zeros((n,), jnp.int32),
     )
 
 
@@ -61,7 +61,7 @@ def ready_push(ring: ReadyRing, p, client, rifl_seq, enable=True) -> ReadyRing:
             jnp.where(do, rifl_seq, ring.rifl_seq[p, idx])
         ),
         push=ring.push.at[p].add(do.astype(jnp.int32)),
-        overflow=ring.overflow + (enable & full).astype(jnp.int32),
+        overflow=ring.overflow.at[p].add((enable & full).astype(jnp.int32)),
     )
 
 

@@ -60,7 +60,7 @@ class TableExecState(NamedTuple):
     vt_frontier: jnp.ndarray  # [n, K, n] int32
     vt_ps: jnp.ndarray  # [n, K, n, R] int32 pending range starts (0 = empty)
     vt_pe: jnp.ndarray  # [n, K, n, R] int32 pending range ends
-    vt_overflow: jnp.ndarray  # int32 — must stay 0
+    vt_overflow: jnp.ndarray  # [n] int32 — must stay 0
     # pending committed commands (the per-key sorted `ops` maps)
     tbl_clock: jnp.ndarray  # [n, DOTS] int32 commit timestamp
     tbl_pending: jnp.ndarray  # [n, DOTS, KPC] bool entry not yet executed
@@ -84,7 +84,7 @@ def make_executor(n: int) -> ExecutorDef:
             vt_frontier=jnp.zeros((n, K, n), jnp.int32),
             vt_ps=jnp.zeros((n, K, n, R), jnp.int32),
             vt_pe=jnp.zeros((n, K, n, R), jnp.int32),
-            vt_overflow=jnp.int32(0),
+            vt_overflow=jnp.zeros((n,), jnp.int32),
             tbl_clock=jnp.zeros((n, DOTS), jnp.int32),
             tbl_pending=jnp.zeros((n, DOTS, KPC), jnp.bool_),
             order_hash=jnp.zeros((n, K), jnp.int32),
@@ -113,7 +113,7 @@ def make_executor(n: int) -> ExecutorDef:
         pe = est.vt_pe.at[p, key, voter, slot].set(
             jnp.where(do_park, e, est.vt_pe[p, key, voter, slot])
         )
-        overflow = est.vt_overflow + (park & ~has_free).astype(jnp.int32)
+        overflow = est.vt_overflow.at[p].add((park & ~has_free).astype(jnp.int32))
 
         # absorb parked ranges that touch the (possibly advanced) frontier;
         # each pass absorbs at least one range or stops, so R passes suffice

@@ -1,0 +1,145 @@
+"""Dense one-hot indexed reads/writes — the TPU-native replacement for
+per-element gather/scatter.
+
+On TPU, XLA lowers a gather or scatter whose indices differ per batch
+element to a serialized per-index loop: measured on v5e, a single-index
+update `x.at[arange(B), idx].set(v)` on a [B, 64] array costs ~17us and a
+matching gather ~25us, i.e. ~8ns per (batch-element, index) regardless of
+row size. A masked broadcast-compare ("one-hot") update of the same array
+costs ~2-4us because it is a pure vector op. Every indexed access on the
+simulation hot path therefore goes through these helpers.
+
+The reference has no analogue — random access into HashMaps is free on a
+CPU (`fantoch/src/protocol/info/mod.rs:13-22` per-dot registries); here the
+registries are dense tensors (SURVEY §7 design stance) and *access* is the
+thing to re-design.
+
+All helpers treat index `i` as traced int32, clip nothing (out-of-range
+one-hots simply match no lane, i.e. reads return 0 and writes drop — the
+same semantics as `.at[].set(mode="drop")`), and broadcast: scalar indices
+on [D, ...] arrays, or batched indices [R] on [D, ...] arrays yielding [R,
+...] reads.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def oh(i, size: int) -> jnp.ndarray:
+    """One-hot bool mask: lanes of `size` matching `i`.
+
+    Scalar i -> [size]; i of shape [...] -> [..., size].
+    """
+    return jnp.arange(size, dtype=jnp.int32) == jnp.asarray(i, jnp.int32)[..., None]
+
+
+def dget(x: jnp.ndarray, i) -> jnp.ndarray:
+    """Read x[i] along axis 0 without a gather.
+
+    x: [D, ...]; scalar i -> [...]; i of shape [R] -> [R, ...].
+    Out-of-range i reads 0.
+    """
+    m = oh(i, x.shape[0])  # [..., D]
+    # align mask lanes with x's axis 0, then reduce
+    extra = x.ndim - 1
+    mm = m.reshape(m.shape + (1,) * extra)  # [..., D, 1...]
+    return jnp.sum(jnp.where(mm, x, 0), axis=m.ndim - 1)
+
+
+def dget2(x: jnp.ndarray, i, j) -> jnp.ndarray:
+    """Read x[i, j] for a [D0, D1, ...] array; scalar or batched [R] indices."""
+    row = dget(x, i)  # [..., D1, ...]
+    if jnp.ndim(jnp.asarray(i)) == 0:
+        return dget(row, j)
+    # batched: row is [R, D1, ...], j is [R]
+    m = oh(j, x.shape[1])  # [R, D1]
+    extra = row.ndim - 2
+    mm = m.reshape(m.shape + (1,) * extra)
+    return jnp.sum(jnp.where(mm, row, 0), axis=1)
+
+
+def dset(x: jnp.ndarray, i, v, where=None) -> jnp.ndarray:
+    """x.at[i].set(v) along axis 0 via one-hot select (scalar i).
+
+    `v` broadcasts against one row of x. `where` (scalar bool) gates the
+    whole write. Out-of-range i writes nothing.
+    """
+    m = oh(i, x.shape[0])  # [D]
+    if where is not None:
+        m = m & where
+    mm = m.reshape(m.shape + (1,) * (x.ndim - 1))
+    return jnp.where(mm, jnp.broadcast_to(jnp.asarray(v, x.dtype), x.shape), x)
+
+
+def dadd(x: jnp.ndarray, i, v, where=None) -> jnp.ndarray:
+    """x.at[i].add(v) along axis 0 via one-hot add (scalar i)."""
+    m = oh(i, x.shape[0])
+    if where is not None:
+        m = m & where
+    mm = m.reshape(m.shape + (1,) * (x.ndim - 1))
+    if x.dtype == jnp.bool_:
+        raise TypeError("dadd on bool array; use dset/dor")
+    return x + jnp.where(mm, jnp.asarray(v, x.dtype), jnp.zeros((), x.dtype))
+
+
+def dor(x: jnp.ndarray, i, v, where=None) -> jnp.ndarray:
+    """x.at[i].set(x[i] | v) for bool arrays (scalar i)."""
+    m = oh(i, x.shape[0])
+    if where is not None:
+        m = m & where
+    mm = m.reshape(m.shape + (1,) * (x.ndim - 1))
+    return x | (mm & jnp.broadcast_to(jnp.asarray(v, jnp.bool_), x.shape))
+
+
+def dset2(x: jnp.ndarray, i, j, v, where=None) -> jnp.ndarray:
+    """x.at[i, j].set(v) for a [D0, D1, ...] array (scalar i, j)."""
+    m = oh(i, x.shape[0])[:, None] & oh(j, x.shape[1])[None, :]  # [D0, D1]
+    if where is not None:
+        m = m & where
+    mm = m.reshape(m.shape + (1,) * (x.ndim - 2))
+    return jnp.where(mm, jnp.broadcast_to(jnp.asarray(v, x.dtype), x.shape), x)
+
+
+def dadd2(x: jnp.ndarray, i, j, v, where=None) -> jnp.ndarray:
+    """x.at[i, j].add(v) for a [D0, D1, ...] array (scalar i, j)."""
+    m = oh(i, x.shape[0])[:, None] & oh(j, x.shape[1])[None, :]
+    if where is not None:
+        m = m & where
+    mm = m.reshape(m.shape + (1,) * (x.ndim - 2))
+    return x + jnp.where(mm, jnp.asarray(v, x.dtype), jnp.zeros((), x.dtype))
+
+
+def dadd_many(x: jnp.ndarray, i, v) -> jnp.ndarray:
+    """x.at[i].add(v) for batched indices i [R] and values v [R] (or [R, ...]).
+
+    Duplicate indices accumulate (scatter-add semantics); out-of-range
+    indices drop. Cost: one [R, D] mask product instead of R scatters.
+    """
+    m = oh(i, x.shape[0])  # [R, D]
+    v = jnp.asarray(v, x.dtype)
+    if v.ndim == 1:
+        contrib = jnp.sum(jnp.where(m, v[:, None], 0), axis=0)  # [D]
+    else:
+        extra = v.ndim - 1
+        mm = m.reshape(m.shape + (1,) * extra)  # [R, D, 1...]
+        contrib = jnp.sum(jnp.where(mm, v[:, None], 0), axis=0)
+    return x + contrib
+
+
+def dset_many(x: jnp.ndarray, i, v, valid) -> jnp.ndarray:
+    """x.at[i].set(v) for batched DISTINCT indices i [R], values v [R, ...],
+    validity mask [R]. Distinctness is the caller's contract (e.g. dot slots
+    assigned per process); with duplicates the max-combine wins arbitrarily.
+    """
+    m = oh(i, x.shape[0]) & jnp.asarray(valid, jnp.bool_)[:, None]  # [R, D]
+    hit = m.any(axis=0)  # [D]
+    v = jnp.asarray(v, x.dtype)
+    extra = v.ndim - 1
+    mm = m.reshape(m.shape + (1,) * extra)
+    merged = jnp.max(
+        jnp.where(mm, v[:, None], jnp.iinfo(jnp.int32).min
+                  if x.dtype != jnp.bool_ else False),
+        axis=0,
+    )
+    hitm = hit.reshape(hit.shape + (1,) * (x.ndim - 1))
+    return jnp.where(hitm, merged.astype(x.dtype), x)

@@ -90,7 +90,7 @@ class AtlasState(NamedTuple):
     synod: synod_mod.SynodState
     bufc_valid: jnp.ndarray  # [n, DOTS] bool buffered MCommit
     bufc_deps: jnp.ndarray  # [n, DOTS, D] int32
-    dep_overflow: jnp.ndarray  # int32 — must stay 0
+    dep_overflow: jnp.ndarray  # [n] int32 — must stay 0
     gc: gc_mod.GCTrack
     fast_count: jnp.ndarray  # [n] int32
     slow_count: jnp.ndarray  # [n] int32
@@ -140,7 +140,7 @@ def _make(
             synod=synod_mod.synod_init(n, DOTS),
             bufc_valid=jnp.zeros((n, DOTS), jnp.bool_),
             bufc_deps=z(n, DOTS, D),
-            dep_overflow=jnp.int32(0),
+            dep_overflow=z(n),
             gc=gc_mod.gc_init(n, DOTS),
             fast_count=z(n),
             slow_count=z(n),
@@ -159,9 +159,11 @@ def _make(
         slot_en = sharding.slot_mask(ctx, dot, shards) if shards > 1 else None
         kd, deps, overflow = deps_mod.add_cmd(
             st.kd, p, dot, keys, ctx.cmds.read_only[dot], past,
-            st.dep_overflow, enable, nfr, slot_en=slot_en,
+            st.dep_overflow[p], enable, nfr, slot_en=slot_en,
         )
-        return st._replace(kd=kd, dep_overflow=overflow), deps
+        return st._replace(
+            kd=kd, dep_overflow=st.dep_overflow.at[p].set(overflow)
+        ), deps
 
     def _commit(ctx, st: AtlasState, p, dot, deps, enable, ob=None, row=0):
         """Commit path (atlas.rs:392-453): mark COMMIT, hand the dep set to
@@ -429,7 +431,7 @@ def _make(
         # so across shards the per-key contributions are disjoint and the
         # total is bounded by sum over keys of 2*(ranks+1) <= D
         row = st.sc_deps[p, dot]
-        overflow = st.dep_overflow
+        overflow = st.dep_overflow[p]
         for j in range(D):
             row, overflow = deps_mod.set_insert(
                 row, rdeps[j], jnp.bool_(True), overflow
@@ -438,7 +440,7 @@ def _make(
         st = st._replace(
             sc_cnt=st.sc_cnt.at[p, dot].set(cnt),
             sc_deps=st.sc_deps.at[p, dot].set(row),
-            dep_overflow=overflow,
+            dep_overflow=st.dep_overflow.at[p].set(overflow),
         )
         touch = sharding.shard_touch(ctx, dot, shards)
         done = cnt == touch.sum()

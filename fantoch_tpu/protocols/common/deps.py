@@ -99,7 +99,7 @@ class QuorumDepsState(NamedTuple):
     count: jnp.ndarray  # [n, DOTS] int32 participants
     dep: jnp.ndarray  # [n, DOTS, D] int32 dep slots (flat dot + 1)
     cnt: jnp.ndarray  # [n, DOTS, D] int32 report count per slot
-    overflow: jnp.ndarray  # int32 — must stay 0
+    overflow: jnp.ndarray  # [n] int32 — must stay 0
 
 
 def quorumdeps_init(n: int, dots: int, max_deps: int) -> QuorumDepsState:
@@ -107,7 +107,7 @@ def quorumdeps_init(n: int, dots: int, max_deps: int) -> QuorumDepsState:
         count=jnp.zeros((n, dots), jnp.int32),
         dep=jnp.zeros((n, dots, max_deps), jnp.int32),
         cnt=jnp.zeros((n, dots, max_deps), jnp.int32),
-        overflow=jnp.int32(0),
+        overflow=jnp.zeros((n,), jnp.int32),
     )
 
 
@@ -117,7 +117,7 @@ def quorumdeps_add(qd: QuorumDepsState, p, dot, deps, enable):
     D = qd.dep.shape[2]
     row_dep = qd.dep[p, dot]
     row_cnt = qd.cnt[p, dot]
-    overflow = qd.overflow
+    overflow = qd.overflow[p]
     for j in range(deps.shape[0]):
         v = deps[j]
         add = enable & (v > 0)
@@ -133,7 +133,7 @@ def quorumdeps_add(qd: QuorumDepsState, p, dot, deps, enable):
         count=qd.count.at[p, dot].add(enable.astype(jnp.int32)),
         dep=qd.dep.at[p, dot].set(row_dep),
         cnt=qd.cnt.at[p, dot].set(row_cnt),
-        overflow=overflow,
+        overflow=qd.overflow.at[p].set(overflow),
     )
 
 

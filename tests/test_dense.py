@@ -1,0 +1,74 @@
+"""ops/dense.py one-hot helpers vs the .at[] ground truth."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from fantoch_tpu.ops import dense
+
+
+def test_oh_scalar_and_batched():
+    assert dense.oh(jnp.int32(2), 4).tolist() == [False, False, True, False]
+    m = dense.oh(jnp.asarray([0, 3, 9]), 4)
+    assert m.shape == (3, 4)
+    assert m[0, 0] and m[1, 3]
+    assert not m[2].any()  # out of range matches nothing
+
+
+def test_dget_matches_indexing():
+    x = jnp.arange(24, dtype=jnp.int32).reshape(6, 4)
+    assert dense.dget(x, jnp.int32(3)).tolist() == x[3].tolist()
+    r = dense.dget(x, jnp.asarray([1, 5, 0]))
+    assert r.tolist() == x[jnp.asarray([1, 5, 0])].tolist()
+    # out-of-range reads zero
+    assert dense.dget(x, jnp.int32(17)).tolist() == [0, 0, 0, 0]
+
+
+def test_dget2():
+    x = jnp.arange(24, dtype=jnp.int32).reshape(6, 4)
+    assert int(dense.dget2(x, jnp.int32(2), jnp.int32(3))) == int(x[2, 3])
+    r = dense.dget2(x, jnp.asarray([0, 5]), jnp.asarray([1, 2]))
+    assert r.tolist() == [int(x[0, 1]), int(x[5, 2])]
+
+
+def test_dset_dadd_dor():
+    x = jnp.zeros((5,), jnp.int32)
+    assert dense.dset(x, jnp.int32(2), 7).tolist() == [0, 0, 7, 0, 0]
+    assert dense.dadd(x, jnp.int32(4), 3).tolist() == [0, 0, 0, 0, 3]
+    assert dense.dset(x, jnp.int32(2), 7, where=jnp.bool_(False)).tolist() == [0] * 5
+    assert dense.dset(x, jnp.int32(99), 7).tolist() == [0] * 5  # dropped
+    b = jnp.zeros((3,), jnp.bool_)
+    assert dense.dor(b, jnp.int32(1), True).tolist() == [False, True, False]
+    # row update on 2D
+    x2 = jnp.zeros((3, 2), jnp.int32)
+    assert dense.dset(x2, jnp.int32(1), jnp.asarray([4, 5])).tolist() == [
+        [0, 0], [4, 5], [0, 0]]
+
+
+def test_dset2_dadd2():
+    x = jnp.zeros((3, 4), jnp.int32)
+    y = dense.dset2(x, jnp.int32(1), jnp.int32(2), 9)
+    assert int(y[1, 2]) == 9 and int(y.sum()) == 9
+    z = dense.dadd2(x, jnp.int32(2), jnp.int32(0), 5)
+    assert int(z[2, 0]) == 5 and int(z.sum()) == 5
+    # 3D: update a whole trailing row
+    x3 = jnp.zeros((2, 3, 2), jnp.int32)
+    y3 = dense.dset2(x3, jnp.int32(0), jnp.int32(1), jnp.asarray([7, 8]))
+    assert y3[0, 1].tolist() == [7, 8] and int(y3.sum()) == 15
+
+
+def test_dadd_many_accumulates_duplicates():
+    x = jnp.zeros((4,), jnp.int32)
+    i = jnp.asarray([1, 1, 3, 9], jnp.int32)
+    v = jnp.asarray([2, 3, 4, 100], jnp.int32)
+    assert dense.dadd_many(x, i, v).tolist() == [0, 5, 0, 4]
+
+
+def test_dset_many_distinct():
+    x = jnp.full((4, 2), -1, jnp.int32)
+    i = jnp.asarray([0, 2, 9], jnp.int32)
+    v = jnp.asarray([[1, 2], [3, 4], [5, 6]], jnp.int32)
+    valid = jnp.asarray([True, True, True])
+    y = dense.dset_many(x, i, v, valid)
+    assert y.tolist() == [[1, 2], [-1, -1], [3, 4], [-1, -1]]
+    y2 = dense.dset_many(x, i, v, jnp.asarray([True, False, True]))
+    assert y2.tolist() == [[1, 2], [-1, -1], [-1, -1], [-1, -1]]
