@@ -11,6 +11,12 @@ single-threaded evaluation rate of ~50k events/sec/core, the right order
 for the reference's per-core discrete-event loop (heap pop + protocol
 handler per event); >1 means one chip beats one CPU core sweeping the same
 grid. Per-protocol breakdown goes to stderr.
+
+Shape notes (round 2): the instant-batched engine handles one message per
+process and per client each sub-round, so throughput scales with clients
+per config until the instant saturates; GC window compaction
+(`max_seq` = ring window) keeps per-dot state and the graph executor's
+closure sized by the in-flight window instead of the run length.
 """
 import json
 import os
@@ -35,33 +41,36 @@ from fantoch_tpu.protocols import tempo as tempo_proto
 # the sweep-throughput baseline is per-core event processing)
 BASELINE_EVENTS_PER_SEC = 50_000.0
 
+# clients spread over three regions so the three coordinators share the load
+# (each region's clients connect to its closest process)
 PLACEMENT = setup.Placement(
-    ["asia-east1", "us-central1", "us-west1"], ["us-west1", "us-west2"], 2
+    ["asia-east1", "us-central1", "us-west1"],
+    ["asia-east1", "us-central1", "us-west1"],
+    4,
 )
 
 
-def build_batch(pdef, n_configs, commands_per_client, conflict_rate=50):
+def build_batch(pdef, n_configs, commands_per_client, window, conflict_rate=50):
     planet = Planet.new()
-    config = Config(n=3, f=1, gc_interval_ms=100)
+    config = Config(
+        n=3, f=1, gc_interval_ms=20,
+        executor_executed_notification_interval_ms=25,
+    )
     workload = Workload(
         1, KeyGen.conflict_pool(conflict_rate, 2), 1, commands_per_client, 100
     )
-    C = 4
+    C = len(PLACEMENT.client_regions) * PLACEMENT.clients_per_region
     spec = setup.build_spec(
         config,
         workload,
         pdef,
         n_clients=C,
-        n_client_groups=2,
+        n_client_groups=len(PLACEMENT.client_regions),
         max_steps=5_000_000,
         extra_ms=1000,
-        # tight in-flight bound: C closed-loop clients keep ~3n messages in
-        # flight each plus GC fan-out. Pool size dominates per-iteration cost
-        # (every step scans/scatters [B, S] pool arrays): S=64 runs the same
-        # workload ~5x faster than S=128 on TPU with identical results;
-        # `dropped` is checked after every run so an undersized pool fails
-        # loudly instead of skewing numbers
-        pool_slots=64,
+        # GC window compaction: per-dot state is a ring over the in-flight
+        # window; submits defer (never drop) if the window fills
+        max_seq=window,
     )
     envs = [
         setup.build_env(spec, config, planet, PLACEMENT, workload, pdef, seed=i)
@@ -70,8 +79,8 @@ def build_batch(pdef, n_configs, commands_per_client, conflict_rate=50):
     return spec, workload, sweep.stack_envs(envs)
 
 
-def run_protocol(name, pdef, n_configs, commands_per_client, chunk_steps):
-    spec, wl, envs = build_batch(pdef, n_configs, commands_per_client)
+def run_protocol(name, pdef, n_configs, commands_per_client, window, chunk_steps):
+    spec, wl, envs = build_batch(pdef, n_configs, commands_per_client, window)
     init, chunk, done = sweep.make_chunked_runner(spec, pdef, wl, chunk_steps)
 
     def run_once():
@@ -111,21 +120,17 @@ def main():
     scale = float(os.environ.get("BENCH_SCALE", "1"))
     chunk_env = os.environ.get("BENCH_CHUNK_STEPS")
     n = 3
-    # per-protocol chunk lengths keep each device call well under the
-    # tunneled-TPU ~40s stall limit at the default batch widths (the
-    # while-loop iteration rate is roughly batch-independent, so chunk
-    # length ~ wall time per call; larger batches need shorter chunks)
     runs = [
-        # (name, pdef, configs, commands/client, chunk_steps)
-        ("basic", basic_proto.make_protocol(n, 1), int(2048 * scale), 50, 2500),
-        ("tempo", tempo_proto.make_protocol(n, 1), int(512 * scale), 20, 2500),
-        ("atlas", atlas_proto.make_protocol(n, 1), int(128 * scale), 10, 3000),
+        # (name, pdef, configs, commands/client, window, chunk_steps)
+        ("basic", basic_proto.make_protocol(n, 1), int(1024 * scale), 100, 32, 40_000),
+        ("tempo", tempo_proto.make_protocol(n, 1), int(512 * scale), 50, 32, 20_000),
+        ("atlas", atlas_proto.make_protocol(n, 1), int(256 * scale), 50, 24, 20_000),
     ]
     total_events, total_time = 0, 0.0
     all_ok = True
-    for name, pdef, n_configs, cmds, chunk_steps in runs:
+    for name, pdef, n_configs, cmds, window, chunk_steps in runs:
         events, elapsed, ok = run_protocol(
-            name, pdef, max(n_configs, 1), cmds,
+            name, pdef, max(n_configs, 1), cmds, window,
             int(chunk_env) if chunk_env else chunk_steps,
         )
         total_events += events

@@ -59,6 +59,8 @@ def cmd_sim(args) -> int:
         nfr=args.nfr,
         tempo_tiny_quorums=args.tiny_quorums,
         tempo_clock_bump_interval_ms=args.clock_bump,
+        tempo_detached_send_interval_ms=args.detached_interval,
+        executor_monitor_pending_interval_ms=args.monitor_pending,
         skip_fast_ack=args.skip_fast_ack,
         execute_at_commit=args.execute_at_commit,
         caesar_wait_condition=not args.no_wait_condition,
@@ -335,6 +337,13 @@ def main(argv=None) -> int:
     ps.add_argument("--tiny-quorums", action="store_true")
     ps.add_argument("--clock-bump", type=int, default=0,
                     help="tempo clock-bump interval ms (0 = off)")
+    ps.add_argument("--detached-interval", type=int, default=0,
+                    help="tempo buffered detached-vote send interval ms"
+                         " (0 = eager broadcast)")
+    ps.add_argument("--monitor-pending", type=int, default=0,
+                    help="executor monitor_pending interval ms (0 = off;"
+                         " supported by the table and graph executors, i.e."
+                         " tempo/atlas/epaxos/janus)")
     ps.add_argument("--skip-fast-ack", action="store_true")
     ps.add_argument("--execute-at-commit", action="store_true")
     ps.add_argument("--no-wait-condition", action="store_true",

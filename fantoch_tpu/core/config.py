@@ -34,7 +34,11 @@ class Config:
     executor_cleanup_interval_ms: int = 5
     executor_monitor_pending_interval_ms: Optional[int] = None
     executor_executed_notification_interval_ms: int = 50
-    executor_monitor_execution_order: bool = False
+    # the reference gates its ExecutionOrderMonitor behind
+    # `executor_monitor_execution_order` because the host-side order lists
+    # cost memory (fantoch/src/config.rs); the dense per-key rolling order
+    # hashes here are O(keys) state updated in O(1), so the monitor is
+    # simply always on and the flag does not exist
 
     # garbage collection (None = disabled)
     gc_interval_ms: Optional[int] = None

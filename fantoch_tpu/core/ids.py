@@ -2,10 +2,24 @@
 
 Reference parity (`fantoch/src/id.rs`): a `Dot = (process, sequence)` names a
 command instance, a `Rifl = (client, sequence)` names a client request. On
-device both are dense int32 pairs; dots additionally flatten into an index
-into `[n * max_seq, ...]` per-protocol state tensors:
+device a dot is one int32 with the coordinator in the high bits and the
+(1-based, unbounded) sequence in the low bits:
 
-    flat(dot) = process_index * max_seq + (sequence - 1)
+    dot = coordinator << GSEQ_BITS | (sequence - 1)
+
+Per-dot state lives in *ring windows* of `W = SimSpec.max_seq` slots per
+coordinator (the GC-compacted analogue of the reference deleting stable dots
+from its per-dot HashMaps, `fantoch/src/protocol/gc/`):
+
+    slot(dot) = coordinator * W + (sequence - 1) % W
+
+A slot is recycled for `sequence + W` only once `sequence` is stable
+(committed + executed) at every process and every process has *reported* so
+(`protocols/common/gc.py` window floors), which guarantees the old
+generation's state was cleared everywhere before any message of the new
+generation can arrive. Handlers detect stragglers that reference a dead
+generation by comparing the dot against the slot's registered generation
+(`CmdView.gdot`) and the GC stable watermark.
 
 Process indices are 0-based on device; the reference's 1-based process ids
 (`util.rs:125-133` — ids must be non-zero because they double as paxos ballot
@@ -16,16 +30,59 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-
-def dot_flat(proc: jnp.ndarray, seq: jnp.ndarray, max_seq: int) -> jnp.ndarray:
-    """Flatten (0-based proc, 1-based seq) into a dense dot index."""
-    return proc.astype(jnp.int32) * max_seq + (seq.astype(jnp.int32) - 1)
-
-
-def dot_proc(flat: jnp.ndarray, max_seq: int) -> jnp.ndarray:
-    return flat // max_seq
+# low bits holding (sequence - 1): 2^21 sequences per coordinator per run,
+# up to 2^10 coordinators, inside one int32
+GSEQ_BITS = 21
+GSEQ_MASK = (1 << GSEQ_BITS) - 1
 
 
-def dot_seq(flat: jnp.ndarray, max_seq: int) -> jnp.ndarray:
-    """1-based sequence of a flat dot."""
-    return flat % max_seq + 1
+def dot_make(proc: jnp.ndarray, seq: jnp.ndarray) -> jnp.ndarray:
+    """Encode (0-based proc, 1-based unbounded seq) into a dot."""
+    return (
+        jnp.asarray(proc, jnp.int32) << GSEQ_BITS
+    ) | ((jnp.asarray(seq, jnp.int32) - 1) & GSEQ_MASK)
+
+
+def dot_proc(dot: jnp.ndarray) -> jnp.ndarray:
+    """Coordinator of a dot."""
+    return jnp.asarray(dot, jnp.int32) >> GSEQ_BITS
+
+
+def dot_seq(dot: jnp.ndarray) -> jnp.ndarray:
+    """1-based sequence of a dot."""
+    return (jnp.asarray(dot, jnp.int32) & GSEQ_MASK) + 1
+
+
+def dot_slot(dot: jnp.ndarray, window: int) -> jnp.ndarray:
+    """Ring-window slot of a dot in `[n * window]` per-dot state tensors."""
+    d = jnp.asarray(dot, jnp.int32)
+    return (d >> GSEQ_BITS) * window + (d & GSEQ_MASK) % window
+
+
+def slot_coord(slot: jnp.ndarray, window: int) -> jnp.ndarray:
+    """Coordinator owning a state slot."""
+    return jnp.asarray(slot, jnp.int32) // window
+
+
+def advance_frontiers(frontier_row, vdot_row, done_row, n: int, window: int):
+    """Advance per-coordinator contiguous frontiers over generation-tagged
+    ring slots: frontier[a] grows while slot `frontier % W` of coordinator
+    `a` holds the matching generation with `done_row` set (the dense
+    `AEClock` advance shared by the executors' executed frontiers).
+
+    `frontier_row` [n], `vdot_row`/`done_row` [n*W]."""
+    import jax
+
+    coords = jnp.arange(n, dtype=jnp.int32)
+
+    def body(carry):
+        fr, _ = carry
+        sl = coords * window + fr % window
+        g = dot_make(coords, fr + 1)
+        can = (vdot_row[sl] == g) & done_row[sl]
+        return fr + can.astype(jnp.int32), can.any()
+
+    fr, _ = jax.lax.while_loop(
+        lambda c: c[1], body, (frontier_row, jnp.bool_(True))
+    )
+    return fr

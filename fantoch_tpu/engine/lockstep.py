@@ -50,7 +50,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core import workload as workload_mod
-from ..core.ids import dot_flat
+from ..core import ids
 from ..ops import dense
 from .types import (
     INF_TIME,
@@ -88,6 +88,7 @@ class SimSpec:
     proto_periodic_ms: Tuple[int, ...]
     proto_periodic_kinds: Tuple[int, ...]  # protocol-side kind index per slot
     executed_ms: Optional[int]  # executed-notification interval (None = off)
+    monitor_ms: Optional[int]  # executor monitor_pending interval (None = off)
     cleanup_ms: int  # executor drain tick
     extra_ms: int  # extra simulated time after clients finish
     reorder: bool  # random ×[0,10) message delay multiplier (sim_test mode)
@@ -117,7 +118,12 @@ class SimSpec:
 
     @property
     def n_periodic(self) -> int:
-        return len(self.proto_periodic_ms) + (self.executed_ms is not None) + 1
+        return (
+            len(self.proto_periodic_ms)
+            + (self.executed_ms is not None)
+            + (self.monitor_ms is not None)
+            + 1
+        )
 
 
 class Env(NamedTuple):
@@ -259,6 +265,10 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
     if spec.executed_ms is not None:
         exec_notify_slot = len(intervals)
         intervals.append(spec.executed_ms)
+    monitor_slot = None
+    if spec.monitor_ms is not None:
+        monitor_slot = len(intervals)
+        intervals.append(spec.monitor_ms)
     cleanup_slot = len(intervals)
     intervals.append(spec.cleanup_ms)
     interval_arr = jnp.asarray(intervals, jnp.int32)  # [NPER]
@@ -266,6 +276,12 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
 
     proc_ids = jnp.arange(n, dtype=jnp.int32)
     iota_S = jnp.arange(S, dtype=jnp.int32)
+
+    # row scheduling: on CPU a statically-unrolled row loop with lax.cond
+    # skips idle rows and dispatches one handler branch (scalar predicates
+    # branch for real); on TPU the vmapped rows keep every op wide. Same row
+    # functions, same results — only the schedule differs.
+    ROW_LOOP = jax.default_backend() == "cpu"
 
     # ------------------------------------------------------------------
     # pool insertion (bulk, dense)
@@ -440,8 +456,71 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             closest_shard_proc=er.closest_shard_proc[None, :],
         )
 
-    def _proc_rows(st: SimState, env: Env, cmds: CmdView, has, kind, src, payload, flat, subok):
-        """Handle one message per process, vmapped over the process axis.
+    def _slice_env(env: Env, pid: int) -> Env:
+        """Static per-process env view (leading axis kept at length 1)."""
+        return env._replace(
+            dist_pp=env.dist_pp[pid:pid + 1],
+            dist_pc=env.dist_pc[pid:pid + 1],
+            sorted_procs=env.sorted_procs[pid:pid + 1],
+            fq_mask=env.fq_mask[pid:pid + 1],
+            wq_mask=env.wq_mask[pid:pid + 1],
+            maj_mask=env.maj_mask[pid:pid + 1],
+            all_mask=env.all_mask[pid:pid + 1],
+            closest_shard_proc=env.closest_shard_proc[pid:pid + 1],
+        )
+
+    def _proc_row_core(ctx, proto1, exec1, has_p, kind_p, src_p, pay_p, flat_p, subok_p, now):
+        """One process's message handling on a lifted 1-row state.
+
+        `ROW_LOOP` (CPU) dispatches submit-vs-protocol with real branches
+        (`lax.cond` with scalar predicates executes one side); the vmapped
+        TPU path computes both and selects, which is free there because the
+        config batch makes the predicate a vector anyway.
+        """
+        z = jnp.int32(0)
+        is_sub = has_p & (kind_p == KIND_SUBMIT)
+        is_proto = has_p & (kind_p >= KIND_PROTO_BASE)
+        pk = jnp.clip(kind_p - KIND_PROTO_BASE, 0, pdef.n_msg_kinds - 1)
+
+        def sub_path(_):
+            pst, ob, ex = pdef.submit(ctx, proto1, z, flat_p, now)
+            pst = _tree_select(subok_p & is_sub, pst, proto1)
+            return pst, ob._replace(valid=ob.valid & subok_p & is_sub), ex._replace(valid=ex.valid & subok_p & is_sub)
+
+        def proto_path(_):
+            pst, ob, ex = pdef.handle(ctx, proto1, z, src_p, pk, pay_p, now)
+            pst = _tree_select(is_proto, pst, proto1)
+            return pst, ob._replace(valid=ob.valid & is_proto), ex._replace(valid=ex.valid & is_proto)
+
+        if ROW_LOOP:
+            pst, ob, ex = jax.lax.cond(is_sub, sub_path, proto_path, None)
+        else:
+            pst_s, ob_s, ex_s = sub_path(None)
+            pst_h, ob_h, ex_h = proto_path(None)
+            pst = _tree_select(is_sub, pst_s, pst_h)
+            ob = Outbox(
+                valid=jnp.where(is_sub, ob_s.valid, ob_h.valid),
+                tgt_mask=jnp.where(is_sub, ob_s.tgt_mask, ob_h.tgt_mask),
+                kind=jnp.where(is_sub, ob_s.kind, ob_h.kind),
+                payload=jnp.where(is_sub, ob_s.payload, ob_h.payload),
+            )
+            ex = ExecOut(
+                valid=jnp.where(is_sub, ex_s.valid, ex_h.valid),
+                info=jnp.where(is_sub[None, None], ex_s.info, ex_h.info),
+            )
+
+        est = exec1
+        for i in range(pdef.max_exec):
+            newe = exdef.handle(ctx, est, z, ex.info[i], now)
+            est = _tree_select(ex.valid[i], newe, est)
+        est, res = exdef.drain(ctx, est, z)
+        est = _tree_select(has_p, est, exec1)
+        res = res._replace(valid=res.valid & has_p)
+        return pst, est, ob, res
+
+    def _proc_rows(st: SimState, env: Env, cmds: CmdView, has, kind, src, payload, gdot, subok):
+        """Handle one message per process — vmapped over the process axis on
+        TPU, a statically-unrolled loop with idle-row skipping on CPU.
 
         Handlers are row-local (Ctx docstring, engine/types.py): the row is
         lifted to a 1-row state and handled at index 0 with `ctx.pid`
@@ -450,43 +529,58 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         """
         now = st.now
 
+        if ROW_LOOP:
+            prots, execs, obs, ress = [], [], [], []
+            for pid in range(n):
+                proto1 = jax.tree_util.tree_map(lambda a: a[pid:pid + 1], st.proto)
+                exec1 = jax.tree_util.tree_map(lambda a: a[pid:pid + 1], st.exec)
+                ctx = Ctx(spec=spec, env=_slice_env(env, pid), cmds=cmds,
+                          pid=jnp.int32(pid))
+
+                def active(_, proto1=proto1, exec1=exec1, ctx=ctx, pid=pid):
+                    return _proc_row_core(
+                        ctx, proto1, exec1, has[pid], kind[pid], src[pid],
+                        payload[pid], gdot[pid], subok[pid], now,
+                    )
+
+                def idle(_, proto1=proto1, exec1=exec1):
+                    return (
+                        proto1, exec1,
+                        Outbox(
+                            valid=jnp.zeros((MO,), jnp.bool_),
+                            tgt_mask=jnp.zeros((MO,), jnp.int32),
+                            kind=jnp.zeros((MO,), jnp.int32),
+                            payload=jnp.zeros((MO, pdef.msg_width), jnp.int32),
+                        ),
+                        _empty_res(),
+                    )
+
+                pst, est, ob, res = jax.lax.cond(has[pid], active, idle, None)
+                prots.append(pst)
+                execs.append(est)
+                obs.append(ob)
+                ress.append(res)
+            cat = lambda *xs: jnp.concatenate(xs)
+            return (
+                jax.tree_util.tree_map(cat, *prots),
+                jax.tree_util.tree_map(cat, *execs),
+                jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *obs),
+                jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *ress),
+            )
+
         def row(pid, env_row, proto_row, exec_row, has_p, kind_p, src_p, pay_p, flat_p, subok_p):
             proto1 = _lift(proto_row)
             exec1 = _lift(exec_row)
             ctx = Ctx(spec=spec, env=_lift_env(env_row), cmds=cmds, pid=pid)
-            z = jnp.int32(0)
-            is_sub = has_p & (kind_p == KIND_SUBMIT)
-            is_proto = has_p & (kind_p >= KIND_PROTO_BASE)
-
-            pst_s, ob_s, ex_s = pdef.submit(ctx, proto1, z, flat_p, now)
-            pst_s = _tree_select(subok_p, pst_s, proto1)
-            pk = jnp.clip(kind_p - KIND_PROTO_BASE, 0, pdef.n_msg_kinds - 1)
-            pst_h, ob_h, ex_h = pdef.handle(ctx, proto1, z, src_p, pk, pay_p, now)
-
-            pst = _tree_select(is_sub, pst_s, _tree_select(is_proto, pst_h, proto1))
-            ob = Outbox(
-                valid=jnp.where(
-                    is_sub, ob_s.valid & subok_p, ob_h.valid & is_proto
-                ),
-                tgt_mask=jnp.where(is_sub, ob_s.tgt_mask, ob_h.tgt_mask),
-                kind=jnp.where(is_sub, ob_s.kind, ob_h.kind),
-                payload=jnp.where(is_sub, ob_s.payload, ob_h.payload),
+            pst, est, ob, res = _proc_row_core(
+                ctx, proto1, exec1, has_p, kind_p, src_p, pay_p, flat_p,
+                subok_p, now,
             )
-            ex_valid = jnp.where(is_sub, ex_s.valid & subok_p, ex_h.valid & is_proto)
-            ex_info = jnp.where(is_sub[None, None], ex_s.info, ex_h.info)
-
-            est = exec1
-            for i in range(pdef.max_exec):
-                newe = exdef.handle(ctx, est, z, ex_info[i], now)
-                est = _tree_select(ex_valid[i], newe, est)
-            est, res = exdef.drain(ctx, est, z)
-            est = _tree_select(has_p, est, exec1)
-            res = res._replace(valid=res.valid & has_p)
             return _unlift(pst), _unlift(est), ob, res
 
         return jax.vmap(
             row, in_axes=(0, ENV_AXES, 0, 0, 0, 0, 0, 0, 0, 0)
-        )(proc_ids, env, st.proto, st.exec, has, kind, src, payload, flat, subok)
+        )(proc_ids, env, st.proto, st.exec, has, kind, src, payload, gdot, subok)
 
     def _client_rows(st: SimState, env: Env, has, kind, payload):
         """Handle one message per client (reply or open-loop tick), vmapped
@@ -639,13 +733,43 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             )
 
         cids = jnp.arange(C, dtype=jnp.int32)
-        out = jax.vmap(row)(
-            cids, env.client_group, env.client_proc, env.dist_cp,
-            st.c_start, st.c_issued, st.c_resp, st.c_sub_time, st.c_done,
-            st.b_cnt, st.b_first_rifl, st.b_first_time, st.b_keys, st.b_ro,
-            st.c_batch_count, st.lat_sum, st.lat_cnt,
-            has, kind, payload,
-        )
+        if ROW_LOOP and C <= 16:
+            outs = []
+            for cid in range(C):
+                args = (
+                    jnp.int32(cid), env.client_group[cid],
+                    env.client_proc[cid], env.dist_cp[cid],
+                    st.c_start[cid], st.c_issued[cid], st.c_resp[cid],
+                    st.c_sub_time[cid], st.c_done[cid], st.b_cnt[cid],
+                    st.b_first_rifl[cid], st.b_first_time[cid],
+                    st.b_keys[cid], st.b_ro[cid], st.c_batch_count[cid],
+                    st.lat_sum[cid], st.lat_cnt[cid],
+                    has[cid], kind[cid], payload[cid],
+                )
+
+                def active(_, args=args):
+                    return row(*args)
+
+                def idle(_, args=args):
+                    return args[4:17] + (
+                        jnp.zeros((NR,), jnp.int32),
+                        jnp.zeros((NR,), jnp.bool_),
+                        jnp.bool_(False), jnp.int32(0), jnp.int32(0),
+                        jnp.zeros((W,), jnp.int32), jnp.bool_(False),
+                    )
+
+                outs.append(jax.lax.cond(has[cid], active, idle, None))
+            out = tuple(
+                jnp.stack([o[i] for o in outs]) for i in range(len(outs[0]))
+            )
+        else:
+            out = jax.vmap(row)(
+                cids, env.client_group, env.client_proc, env.dist_cp,
+                st.c_start, st.c_issued, st.c_resp, st.c_sub_time, st.c_done,
+                st.b_cnt, st.b_first_rifl, st.b_first_time, st.b_keys, st.b_ro,
+                st.c_batch_count, st.lat_sum, st.lat_cnt,
+                has, kind, payload,
+            )
         (c_start, c_issued, c_resp, c_sub_time, c_done, b_cnt, b_first_rifl,
          b_first_time, b_keys, b_ro, c_batch_count, lat_sum, lat_cnt,
          lat_vals, lat_en, sub_valid, sub_base, sub_dst, sub_payload,
@@ -696,8 +820,32 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
     # deliverable message
     # ------------------------------------------------------------------
 
+    def _can_alloc(st: SimState) -> jnp.ndarray:
+        """[n] bool: may coordinator p allocate its next sequence now?
+
+        With GC window compaction (ProtocolDef.window_floor) a slot is
+        recycled only once every peer *reported* the previous occupant
+        stable; without it the legacy guard drops past the static window.
+        """
+        if pdef.window_floor is None:
+            return st.next_seq <= spec.max_seq
+        return st.next_seq <= pdef.window_floor(st.proto) + spec.max_seq
+
+    def _eff_deliv(st: SimState) -> jnp.ndarray:
+        """[S] deliverable now — excluding submits whose coordinator's dot
+        window is full (they wait in the pool; GC frees slots over time)."""
+        deliv = st.m_valid & (st.m_time <= st.now)
+        if pdef.window_floor is None:
+            return deliv
+        can = _can_alloc(st)  # [n]
+        can_of_dst = (
+            dense.oh(jnp.clip(st.m_dst, 0, n - 1), n) & can[None, :]
+        ).any(axis=1)
+        blocked_sub = (st.m_kind == KIND_SUBMIT) & ~can_of_dst
+        return deliv & ~blocked_sub
+
     def _delivery_round(env: Env, st: SimState) -> SimState:
-        deliv = st.m_valid & (st.m_time <= st.now)  # [S]
+        deliv = _eff_deliv(st)  # [S]
         is_procmsg = (st.m_kind == KIND_SUBMIT) | (st.m_kind >= KIND_PROTO_BASE)
 
         def select(dest_mask):
@@ -738,8 +886,15 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         # --- submit pre-phase: register commands in the dense table ---
         is_sub = has_p & (kind_p == KIND_SUBMIT)
         seq = st.next_seq  # [n]
-        ok = is_sub & (seq <= spec.max_seq)  # dot-window overflow guard
-        flat = jnp.clip(dot_flat(proc_ids, seq, spec.max_seq), 0, DOTS - 1)
+        # windowed protocols never select a submit unless the slot is free
+        # (_eff_deliv); the static guard remains as the legacy drop path
+        ok = is_sub & (
+            jnp.ones((n,), jnp.bool_)
+            if pdef.window_floor is not None
+            else seq <= spec.max_seq
+        )
+        gdot = ids.dot_make(proc_ids, seq)
+        flat = jnp.clip(ids.dot_slot(gdot, spec.max_seq), 0, DOTS - 1)
         sub_client = payload_p[:, 0]
         sub_rifl = payload_p[:, 1]
         sub_ro = payload_p[:, 2].astype(jnp.bool_)
@@ -764,7 +919,7 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         # --- handlers (post-write command view) ---
         cmds = CmdView(st.cmd_client, st.cmd_rifl, st.cmd_keys, st.cmd_ro)
         proto, exc, ob, res = _proc_rows(
-            st, env, cmds, has_p, kind_p, src_p, payload_p, flat, ok
+            st, env, cmds, has_p, kind_p, src_p, payload_p, gdot, ok
         )
         st = st._replace(proto=proto, exec=exc)
         st, replies = _route_results(st, env, res)
@@ -776,9 +931,7 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         def cond(s):
             # the step bound also backstops a (buggy) zero-delay message
             # ping-pong inside one instant, like the outer loop's max_steps
-            return (s.m_valid & (s.m_time <= s.now)).any() & (
-                s.step < spec.max_steps
-            )
+            return _eff_deliv(s).any() & (s.step < spec.max_steps)
 
         return jax.lax.while_loop(
             cond, functools.partial(_delivery_round, env), st
@@ -795,6 +948,49 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         def periodic_rows(st, due, fn):
             """Apply `fn(ctx, row_states...) -> (new rows..., outbox)` per
             process with due-masking; returns new state + outbox."""
+
+            if ROW_LOOP:
+                prots, execs, obs, ress = [], [], [], []
+                for pid in range(n):
+                    proto1 = jax.tree_util.tree_map(
+                        lambda a: a[pid:pid + 1], st.proto
+                    )
+                    exec1 = jax.tree_util.tree_map(
+                        lambda a: a[pid:pid + 1], st.exec
+                    )
+                    ctx = Ctx(spec=spec, env=_slice_env(env, pid), cmds=cmds,
+                              pid=jnp.int32(pid))
+                    ob_aval = jax.eval_shape(
+                        lambda pr, ex: fn(ctx, pr, ex), proto1, exec1
+                    )[2]
+
+                    def active(_, ctx=ctx, proto1=proto1, exec1=exec1):
+                        return fn(ctx, proto1, exec1)
+
+                    def idle(_, proto1=proto1, exec1=exec1, ob_aval=ob_aval):
+                        return (
+                            proto1, exec1,
+                            Outbox(
+                                valid=jnp.zeros(ob_aval.valid.shape, jnp.bool_),
+                                tgt_mask=jnp.zeros(ob_aval.tgt_mask.shape, jnp.int32),
+                                kind=jnp.zeros(ob_aval.kind.shape, jnp.int32),
+                                payload=jnp.zeros(ob_aval.payload.shape, jnp.int32),
+                            ),
+                            _empty_res(),
+                        )
+
+                    pst, est, ob, res = jax.lax.cond(due[pid], active, idle, None)
+                    prots.append(pst)
+                    execs.append(est)
+                    obs.append(ob)
+                    ress.append(res)
+                cat = lambda *xs: jnp.concatenate(xs)
+                return (
+                    jax.tree_util.tree_map(cat, *prots),
+                    jax.tree_util.tree_map(cat, *execs),
+                    jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *obs),
+                    jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *ress),
+                )
 
             def row(pid, env_row, proto_row, exec_row, due_p):
                 proto1 = _lift(proto_row)
@@ -835,6 +1031,11 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
                         ctx, proto1, jnp.int32(0), info, st.now
                     )
                     return pst, est, ob, _empty_res()
+            elif monitor_slot is not None and k == monitor_slot:
+
+                def fn(ctx, proto1, exec1):
+                    est = exdef.monitor(ctx, exec1, jnp.int32(0))
+                    return proto1, est, _empty_ob(), _empty_res()
             else:  # executor cleanup tick
 
                 def fn(ctx, proto1, exec1):
@@ -968,7 +1169,9 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         )
 
     def body(env: Env, st: SimState) -> SimState:
-        times = jnp.where(st.m_valid, st.m_time, INF_TIME)
+        # window-blocked submits do not pin the clock: time advances past
+        # them and they deliver at the first instant GC frees their slot
+        times = jnp.where(_eff_deliv(st._replace(now=INF_TIME)), st.m_time, INF_TIME)
         t_pool = times.min()
         t_per = st.per_next.min()
         now = jnp.minimum(t_pool, t_per)
@@ -976,7 +1179,17 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         # pool messages first (the reference pops pool actions before
         # periodic events on time ties), then timers, then cascades
         st = _msg_subrounds(env, st)
-        st = _fire_periodic(env, st)
+        if ROW_LOOP:
+            # scalar predicate -> real branch: skip the timer machinery on
+            # instants with nothing due (most of them)
+            st = jax.lax.cond(
+                st.per_next.min() <= st.now,
+                functools.partial(_fire_periodic, env),
+                lambda s: s,
+                st,
+            )
+        else:
+            st = _fire_periodic(env, st)
         st = _msg_subrounds(env, st)
         clients_done = st.c_done.sum()
         all_done = clients_done >= C

@@ -63,6 +63,15 @@ def build_spec(
         " support partial replication yet)"
     )
     total_cmds = n_clients * workload.commands_per_client
+    # dots encode (coordinator, sequence) in one int32 with GSEQ_BITS of
+    # sequence; window compaction makes sequences unbounded by design, so
+    # guard the encoding here (worst case: one coordinator takes every
+    # command)
+    from ..core import ids as _ids
+    assert total_cmds < (1 << _ids.GSEQ_BITS), (
+        f"{total_cmds} commands exceed the {1 << _ids.GSEQ_BITS}-sequence"
+        " dot encoding (core/ids.py GSEQ_BITS)"
+    )
     if max_seq is None:
         # worst case: every command coordinated by one process
         max_seq = total_cmds
@@ -75,9 +84,12 @@ def build_spec(
         # be colocated with their coordinator. On top: ~3 rounds of n messages
         # per outstanding command and periodic GC fan-out.
         zl = n_clients if zero_latency_clients is None else zero_latency_clients
+        # with GC window compaction the in-flight message population is
+        # bounded by the dot window, not the run length
+        burst = min(workload.commands_per_client, max_seq)
         pool_slots = max(
             256,
-            2 * (n_total - 1) * workload.commands_per_client * max(zl, 1)
+            2 * (n_total - 1) * burst * max(zl, 1)
             + 4 * n_clients * n_total
             + 4 * n_total * n_total,
         )
@@ -93,6 +105,11 @@ def build_spec(
     executed_ms = (
         config.executor_executed_notification_interval_ms
         if pdef.handle_executed is not None
+        else None
+    )
+    monitor_ms = (
+        config.executor_monitor_pending_interval_ms
+        if pdef.executor.monitor is not None
         else None
     )
 
@@ -112,6 +129,7 @@ def build_spec(
         proto_periodic_ms=tuple(proto_ms),
         proto_periodic_kinds=tuple(proto_kinds),
         executed_ms=executed_ms,
+        monitor_ms=monitor_ms,
         cleanup_ms=config.executor_cleanup_interval_ms,
         extra_ms=extra_ms,
         reorder=reorder,

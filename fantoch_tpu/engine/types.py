@@ -156,6 +156,10 @@ class ExecutorDef:
     # optional committed/executed frontier notification (Executor::executed)
     executed_width: int = 0
     executed: Optional[Callable[..., Any]] = None  # (ctx, estate, p) -> (estate, info [executed_width])
+    # periodic pending-command diagnostics (Executor::monitor_pending,
+    # fantoch/src/executor/mod.rs:76-86): snapshot the pending backlog into
+    # gauge state on Config.executor_monitor_pending_interval_ms
+    monitor: Optional[Callable[..., Any]] = None  # (ctx, estate, p) -> estate
     # executor-metric extraction from final state -> dict of arrays
     # (ExecutorMetrics, fantoch/src/executor/mod.rs:123-130); keys ending in
     # "_hist" are [n, B] bucketed histograms (protocols/common/mhist.py)
@@ -180,6 +184,15 @@ class ProtocolDef:
     periodic: Optional[Callable[..., Any]] = None  # (ctx, pstate, p, kind, now) -> (pstate, Outbox)
     # executor executed-notification consumer (Protocol::handle_executed)
     handle_executed: Optional[Callable[..., Any]] = None  # (ctx, pstate, p, info, now) -> (pstate, Outbox)
+    # GC window compaction (dot-slot recycling): returns [n] int32 — for
+    # each coordinator p, the highest sequence of p's dots that every peer
+    # has REPORTED stable at process p's row (protocols/common/gc.py window
+    # floors). When present, the engine defers a coordinator's submits while
+    # `next_seq > floor[p] + max_seq` instead of dropping past the static
+    # window, making per-dot state a ring over the in-flight window (the
+    # device analogue of the reference deleting stable per-dot state,
+    # `fantoch/src/protocol/gc/`).
+    window_floor: Optional[Callable[[Any], Any]] = None
     # host-side: quorum sizes for Env construction -> (fast, write, stability_threshold)
     quorum_sizes: Callable[[Any], Tuple[int, int, int]] = None
     # whether this protocol requires a leader (FPaxos)

@@ -48,6 +48,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..core import ids
 from ..engine.types import ExecutorDef
 from ..ops.closure import transitive_closure
 from ..protocols.common.mhist import hist_add, hist_init
@@ -65,14 +66,25 @@ CHAIN_BUCKETS = 128
 
 class GraphExecState(NamedTuple):
     kvs: jnp.ndarray  # [n, K] int32
+    vdot: jnp.ndarray  # [n, DOTS] int32 generation (dot) occupying each ring
+    # slot; -1 = never used. Slots recycle once the old occupant is stable
+    # (GC window compaction) — its executed-ness is then captured by
+    # exec_frontier, so the bits are free to overwrite.
+    exec_frontier: jnp.ndarray  # [n, n] int32 contiguous executed seqs per
+    # coordinator (the reference's `executed_clock` AEClock, graph/mod.rs:55)
     committed: jnp.ndarray  # [n, DOTS] bool vertex present
     executed: jnp.ndarray  # [n, DOTS] bool
-    deps: jnp.ndarray  # [n, DOTS, D] int32 flat dot + 1 (0 = empty)
+    deps: jnp.ndarray  # [n, DOTS, D] int32 dot + 1 (0 = empty)
     order_hash: jnp.ndarray  # [n, K] int32
     order_cnt: jnp.ndarray  # [n, K] int32
     executed_count: jnp.ndarray  # [n] int32 commands executed
     chain_max: jnp.ndarray  # [n] int32 largest ready batch
-    requested: jnp.ndarray  # [n, DOTS] bool cross-shard dep request sent
+    requested: jnp.ndarray  # [n, DOTS] bool cross-shard dep request in
+    # flight (cleared when the reply ingests the vertex or the slot recycles)
+    out_requests: jnp.ndarray  # [n] int32 cumulative requests issued
+    # (OutRequests, graph/mod.rs:553)
+    pending_max: jnp.ndarray  # [n] int32 monitor_pending high-water mark
+    monitor_runs: jnp.ndarray  # [n] int32 monitor_pending invocations
     recv_ms: jnp.ndarray  # [n, DOTS] int32 vertex-creation time
     chain_hist: jnp.ndarray  # [n, CB] ChainSize: committed SCC sizes (graph/mod.rs:493)
     delay_hist: jnp.ndarray  # [n, HB] ExecutionDelay: commit->execute ms (graph/mod.rs:518)
@@ -104,6 +116,8 @@ def make_executor(
         DOTS = spec.dots
         return GraphExecState(
             kvs=jnp.zeros((n, spec.key_space), jnp.int32),
+            vdot=jnp.full((n, DOTS), -1, jnp.int32),
+            exec_frontier=jnp.zeros((n, n), jnp.int32),
             committed=jnp.zeros((n, DOTS), jnp.bool_),
             executed=jnp.zeros((n, DOTS), jnp.bool_),
             deps=jnp.zeros((n, DOTS, D), jnp.int32),
@@ -112,6 +126,9 @@ def make_executor(
             executed_count=jnp.zeros((n,), jnp.int32),
             chain_max=jnp.zeros((n,), jnp.int32),
             requested=jnp.zeros((n, DOTS), jnp.bool_),
+            out_requests=jnp.zeros((n,), jnp.int32),
+            pending_max=jnp.zeros((n,), jnp.int32),
+            monitor_runs=jnp.zeros((n,), jnp.int32),
             recv_ms=jnp.zeros((n, DOTS), jnp.int32),
             chain_hist=hist_init(n, CHAIN_BUCKETS),
             delay_hist=hist_init(n, spec.hist_buckets),
@@ -122,20 +139,28 @@ def make_executor(
 
     def _try_execute(ctx, est: GraphExecState, p, now):
         DOTS = est.committed.shape[1]
+        W = ctx.spec.max_seq
         KPC = ctx.spec.keys_per_command
         dots = jnp.arange(DOTS, dtype=jnp.int32)
 
         V = est.committed[p] & ~est.executed[p]  # [DOTS]
         dep = est.deps[p]  # [DOTS, D]
         has_dep = dep > 0
-        tgt = jnp.clip(dep - 1, 0, DOTS - 1)  # [DOTS, D]
-        dep_known = est.committed[p][tgt] | est.executed[p][tgt]
-        bad = (has_dep & ~dep_known).any(axis=1) & V  # [DOTS]
+        dep_dot = dep - 1
+        tgt = jnp.clip(ids.dot_slot(dep_dot, W), 0, DOTS - 1)  # [DOTS, D]
+        # a dependency is satisfied once its coordinator's contiguous
+        # executed frontier covers it (survives slot recycling), known while
+        # its live generation sits committed in the window
+        dep_fr = est.exec_frontier[p][jnp.clip(ids.dot_proc(dep_dot), 0, n - 1)]
+        dep_done = has_dep & (ids.dot_seq(dep_dot) <= dep_fr)
+        gen_ok = est.vdot[p][tgt] == dep_dot
+        dep_live = gen_ok & (est.committed[p][tgt] | est.executed[p][tgt])
+        bad = (has_dep & ~dep_done & ~dep_live).any(axis=1) & V  # [DOTS]
 
         # adjacency restricted to V (edges to executed vertices are satisfied)
         A = jnp.zeros((DOTS, DOTS), jnp.bool_)
         for j in range(D):
-            edge = V & has_dep[:, j] & V[tgt[:, j]]
+            edge = V & has_dep[:, j] & ~dep_done[:, j] & gen_ok[:, j] & V[tgt[:, j]]
             A = A.at[dots, tgt[:, j]].max(edge)
 
         # transitive closure by boolean matrix squaring (ops/closure.py:
@@ -172,7 +197,12 @@ def make_executor(
             e, u = carry
             r = jnp.where(u, rank, jnp.int32(2**30))
             rmin = r.min()
-            d = jnp.where(r == rmin, dots, jnp.int32(2**30)).min()
+            # in-SCC tie-break by DOT (coordinator, sequence) like the
+            # reference (`tarjan.rs:14-15`) — ring slots can wrap, so slot
+            # order is not dot order; the per-slot generation is
+            d = jnp.argmin(
+                jnp.where(u & (r == rmin), e.vdot[p], jnp.int32(2**30))
+            ).astype(jnp.int32)
             client = ctx.cmds.client[d]
             rifl = ctx.cmds.rifl_seq[d]
             kvs, oh, oc, ready = e.kvs, e.order_hash, e.order_cnt, e.ready
@@ -209,21 +239,41 @@ def make_executor(
             return e, u.at[d].set(False)
 
         est, _ = jax.lax.while_loop(cond, body, (est, U))
-        return est
+
+        # advance the contiguous executed frontier per coordinator (AEClock)
+        fr = ids.advance_frontiers(
+            est.exec_frontier[p], est.vdot[p], est.executed[p], n, W
+        )
+        return est._replace(exec_frontier=est.exec_frontier.at[p].set(fr))
 
     def handle(ctx, est: GraphExecState, p, info, now):
-        dot = info[0]
+        # a negative dot is an executed-notice (`RequestReply::Executed`,
+        # executor/graph/mod.rs:34-43): the vertex is stable at its home
+        # shard, so it is satisfied here without deps or execution effects
+        notice = info[0] < 0
+        dot = jnp.where(notice, -info[0] - 1, info[0])
+        sl = ids.dot_slot(dot, ctx.spec.max_seq)
+        fresh = est.vdot[p, sl] != dot  # first delivery of this generation
         est = est._replace(
-            committed=est.committed.at[p, dot].set(True),
-            deps=est.deps.at[p, dot].set(info[1 : 1 + D]),
-            recv_ms=est.recv_ms.at[p, dot].set(
-                jnp.where(est.committed[p, dot], est.recv_ms[p, dot], now)
+            vdot=est.vdot.at[p, sl].set(dot),
+            committed=est.committed.at[p, sl].set(True),
+            executed=est.executed.at[p, sl].set(
+                (est.executed[p, sl] & ~fresh) | notice
+            ),
+            requested=est.requested.at[p, sl].set(
+                est.requested[p, sl] & ~fresh
+            ),
+            deps=est.deps.at[p, sl].set(
+                jnp.where(notice, est.deps[p, sl] * 0, info[1 : 1 + D])
+            ),
+            recv_ms=est.recv_ms.at[p, sl].set(
+                jnp.where(fresh, now, est.recv_ms[p, sl])
             ),
         )
         if exec_log:
             est = est._replace(
                 log_dot=est.log_dot.at[p, est.log_len[p]].set(
-                    dot + 1, mode="drop"
+                    sl + 1, mode="drop"
                 ),
                 log_len=est.log_len.at[p].add(1),
             )
@@ -233,13 +283,13 @@ def make_executor(
             # guards against re-delivered dots (MDEPREPLY under partial
             # replication) double-executing
             KPC = ctx.spec.keys_per_command
-            fresh = ~est.executed[p, dot]
-            client = ctx.cmds.client[dot]
-            rifl = ctx.cmds.rifl_seq[dot]
+            fresh_exec = ~est.executed[p, sl]
+            client = ctx.cmds.client[sl]
+            rifl = ctx.cmds.rifl_seq[sl]
             kvs, ready = est.kvs, est.ready
             for k in range(KPC):
-                key = ctx.cmds.keys[dot, k]
-                owned = fresh & (
+                key = ctx.cmds.keys[sl, k]
+                owned = fresh_exec & (
                     jnp.bool_(True)
                     if shards == 1
                     else key_shard(key, shards) == ctx.env.shard_of[ctx.pid]
@@ -251,9 +301,9 @@ def make_executor(
             return est._replace(
                 kvs=kvs,
                 ready=ready,
-                executed=est.executed.at[p, dot].set(True),
+                executed=est.executed.at[p, sl].set(True),
                 executed_count=est.executed_count.at[p].add(
-                    fresh.astype(jnp.int32)
+                    fresh_exec.astype(jnp.int32)
                 ),
             )
         return _try_execute(ctx, est, p, now)
@@ -263,46 +313,79 @@ def make_executor(
         return est._replace(ready=ready), res
 
     def executed(ctx, est: GraphExecState, p):
-        """Surface up to MAX_REQS missing *remote* dependencies — deps of
-        committed-but-unexecuted vertices that are neither committed nor
-        executed here and whose command touches no local key (so this
+        """The `Executor::executed` notification: the per-coordinator
+        contiguous executed frontier (feeds GC window compaction through
+        `Protocol::handle_executed`), plus — under partial replication — up
+        to MAX_REQS missing *remote* dependencies: deps of
+        committed-but-unexecuted vertices that are neither executed nor
+        committed here and whose command touches no local key (so this
         shard's own agreement will never deliver them). The protocol turns
         each into a dep-request to the dep's shard (the device analogue of
         `DependencyGraph::out_requests`, `executor/graph/mod.rs:59`)."""
+        frontier = est.exec_frontier[p]  # [n]
+        if shards == 1:
+            return est, frontier
         DOTS = est.committed.shape[1]
-        dots = jnp.arange(DOTS, dtype=jnp.int32)
+        W = ctx.spec.max_seq
         V = est.committed[p] & ~est.executed[p]
         dep = est.deps[p]  # [DOTS, D]
         has_dep = dep > 0
-        tgt = jnp.clip(dep - 1, 0, DOTS - 1)
-        unknown = has_dep & ~(est.committed[p][tgt] | est.executed[p][tgt]) & V[:, None]
-        # missing[d] = some unexecuted vertex depends on unknown dot d
-        missing = (
+        dep_dot = dep - 1
+        tgt = jnp.clip(ids.dot_slot(dep_dot, W), 0, DOTS - 1)
+        dep_fr = frontier[jnp.clip(ids.dot_proc(dep_dot), 0, n - 1)]
+        dep_done = has_dep & (ids.dot_seq(dep_dot) <= dep_fr)
+        gen_ok = est.vdot[p][tgt] == dep_dot
+        known = dep_done | (
+            gen_ok & (est.committed[p][tgt] | est.executed[p][tgt])
+        )
+        unknown = has_dep & ~known & V[:, None]  # [DOTS, D]
+        # mark the dep's home slot as requested and surface its dot; dedup
+        # by slot (one in-flight request per missing vertex)
+        miss_slot = (
             jnp.zeros((DOTS,), jnp.bool_)
             .at[jnp.where(unknown, tgt, DOTS)]
             .max(unknown, mode="drop")
         )
+        miss_dot = (
+            jnp.full((DOTS,), -1, jnp.int32)
+            .at[jnp.where(unknown, tgt, DOTS)]
+            .max(jnp.where(unknown, dep_dot, -1), mode="drop")
+        )
         # remote = the dep's command has no key in my shard
         ks = key_shard(ctx.cmds.keys, shards)  # [DOTS, KPC]
         local = (ks == ctx.env.shard_of[ctx.pid]).any(axis=1)
-        cand = missing & ~local & ~est.requested[p]
-        # pick the first MAX_REQS candidates (dot order)
+        cand = miss_slot & ~local & ~est.requested[p]
         idx = jnp.cumsum(cand.astype(jnp.int32)) - 1
         row = (
             jnp.zeros((MAX_REQS,), jnp.int32)
             .at[jnp.where(cand & (idx < MAX_REQS), idx, MAX_REQS)]
-            .set(dots + 1, mode="drop")
+            .set(miss_dot + 1, mode="drop")
         )
         take = cand & (idx < MAX_REQS)
-        est = est._replace(requested=est.requested.at[p].set(est.requested[p] | take))
-        return est, row
+        est = est._replace(
+            requested=est.requested.at[p].set(est.requested[p] | take),
+            out_requests=est.out_requests.at[p].add(take.sum()),
+        )
+        return est, jnp.concatenate([frontier, row])
+
+    def monitor(ctx, est: GraphExecState, p):
+        """monitor_pending (fantoch/src/executor/mod.rs:76-86): snapshot the
+        committed-but-unexecuted backlog into a high-water gauge (the
+        reference logs the pending listing; the gauge is its dense trace)."""
+        pending = (est.committed[p] & ~est.executed[p]).sum()
+        return est._replace(
+            pending_max=est.pending_max.at[p].max(pending),
+            monitor_runs=est.monitor_runs.at[p].add(1),
+        )
 
     def metrics(est: GraphExecState):
         return {
             "chain_size_hist": est.chain_hist,
             "execution_delay_hist": est.delay_hist,
             # OutRequests aggregate (graph/mod.rs:553)
-            "out_requests": est.requested.sum(axis=1),
+            "out_requests": est.out_requests,
+            "pending_max": est.pending_max,
+            "monitor_runs": est.monitor_runs,
         }
 
     return ExecutorDef(
@@ -311,7 +394,8 @@ def make_executor(
         init=init,
         handle=handle,
         drain=drain,
-        executed_width=MAX_REQS if shards > 1 else 0,
-        executed=executed if shards > 1 else None,
+        executed_width=n if shards == 1 else n + MAX_REQS,
+        executed=executed,
+        monitor=monitor,
         metrics=metrics,
     )

@@ -17,6 +17,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..core import ids
 from ..engine.types import ExecutorDef
 from .ready import ReadyRing, ready_capacity, ready_drain, ready_init, ready_push, writer_id
 
@@ -48,12 +49,13 @@ def make_executor(n: int, execute_at_commit: bool = False) -> ExecutorDef:
         KPC = ctx.spec.keys_per_command
         SLOTS = est.buf_dot.shape[1]
         slot, dot = info[0], info[1]
+        csl = ids.dot_slot(dot, ctx.spec.max_seq)
         if execute_at_commit:
-            client = ctx.cmds.client[dot]
-            rifl = ctx.cmds.rifl_seq[dot]
+            client = ctx.cmds.client[csl]
+            rifl = ctx.cmds.rifl_seq[csl]
             kvs, ready = est.kvs, est.ready
             for k in range(KPC):
-                key = ctx.cmds.keys[dot, k]
+                key = ctx.cmds.keys[csl, k]
                 kvs = kvs.at[p, key].set(writer_id(client, rifl))
                 ready = ready_push(ready, p, client, rifl)
             return est._replace(kvs=kvs, ready=ready)
@@ -66,7 +68,7 @@ def make_executor(n: int, execute_at_commit: bool = False) -> ExecutorDef:
 
         def body(e: SlotExecState):
             nxt = e.next_slot[p]
-            d = e.buf_dot[p, nxt - 1]
+            d = ids.dot_slot(e.buf_dot[p, nxt - 1], ctx.spec.max_seq)
             client = ctx.cmds.client[d]
             rifl = ctx.cmds.rifl_seq[d]
             kvs, ready = e.kvs, e.ready

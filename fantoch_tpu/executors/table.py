@@ -38,7 +38,9 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..core import ids
 from ..engine.types import ExecutorDef
+from ..protocols.common.sharding import key_shard
 from .ready import ReadyRing, ready_capacity, ready_drain, ready_init, ready_push, writer_id
 
 ATTACHED = 0
@@ -61,17 +63,25 @@ class TableExecState(NamedTuple):
     vt_ps: jnp.ndarray  # [n, K, n, R] int32 pending range starts (0 = empty)
     vt_pe: jnp.ndarray  # [n, K, n, R] int32 pending range ends
     vt_overflow: jnp.ndarray  # [n] int32 — must stay 0
-    # pending committed commands (the per-key sorted `ops` maps)
+    # pending committed commands (the per-key sorted `ops` maps); DOTS are
+    # ring slots (GC window compaction) tagged with their generation
+    vdot: jnp.ndarray  # [n, DOTS] int32 generation (dot) in each slot (-1 none)
+    exec_frontier: jnp.ndarray  # [n, n] int32 contiguous fully-executed seqs
+    # per coordinator (feeds GC stability via Executor::executed)
+    done_cnt: jnp.ndarray  # [n, DOTS] int32 key entries executed
+    executed: jnp.ndarray  # [n, DOTS] bool all key entries executed
     tbl_clock: jnp.ndarray  # [n, DOTS] int32 commit timestamp
     tbl_pending: jnp.ndarray  # [n, DOTS, KPC] bool entry not yet executed
     # execution-order monitor
+    pending_max: jnp.ndarray  # [n] int32 monitor_pending high-water mark
+    monitor_runs: jnp.ndarray  # [n] int32 monitor_pending invocations
     order_hash: jnp.ndarray  # [n, K] int32 rolling hash of executed dots
     order_cnt: jnp.ndarray  # [n, K] int32
     executed_count: jnp.ndarray  # [n] int32 key-entries executed
     ready: ReadyRing
 
 
-def make_executor(n: int) -> ExecutorDef:
+def make_executor(n: int, shards: int = 1) -> ExecutorDef:
     EW = exec_width(n)
     R = PENDING_RANGES
 
@@ -85,8 +95,14 @@ def make_executor(n: int) -> ExecutorDef:
             vt_ps=jnp.zeros((n, K, n, R), jnp.int32),
             vt_pe=jnp.zeros((n, K, n, R), jnp.int32),
             vt_overflow=jnp.zeros((n,), jnp.int32),
+            vdot=jnp.full((n, DOTS), -1, jnp.int32),
+            exec_frontier=jnp.zeros((n, n), jnp.int32),
+            done_cnt=jnp.zeros((n, DOTS), jnp.int32),
+            executed=jnp.zeros((n, DOTS), jnp.bool_),
             tbl_clock=jnp.zeros((n, DOTS), jnp.int32),
             tbl_pending=jnp.zeros((n, DOTS, KPC), jnp.bool_),
+            pending_max=jnp.zeros((n,), jnp.int32),
+            monitor_runs=jnp.zeros((n,), jnp.int32),
             order_hash=jnp.zeros((n, K), jnp.int32),
             order_cnt=jnp.zeros((n, K), jnp.int32),
             executed_count=jnp.zeros((n,), jnp.int32),
@@ -168,14 +184,26 @@ def make_executor(n: int) -> ExecutorDef:
             pend, on_key = key_pending(e)
             clocks = jnp.where(pend, e.tbl_clock[p], jnp.int32(2**30))
             cmin = clocks.min()
-            # lexicographic (clock, dot) min: smallest dot at the min clock
-            d = jnp.where(clocks == cmin, dots, jnp.int32(2**30)).min()
+            # lexicographic (clock, dot) min: tie-break by GENERATION (ring
+            # slots can wrap, so slot order is not dot order)
+            d = jnp.argmin(
+                jnp.where(clocks == cmin, e.vdot[p], jnp.int32(2**30))
+            ).astype(jnp.int32)
             client = ctx.cmds.client[d]
             rifl = ctx.cmds.rifl_seq[d]
             kslot = jnp.argmax(on_key[d])
+            done = e.done_cnt[p, d] + 1
+            if shards == 1:
+                exp = jnp.int32(KPC)
+            else:
+                # only this shard's key slots produce table entries
+                myshard = ctx.env.shard_of[ctx.pid]
+                exp = (key_shard(ctx.cmds.keys[d], shards) == myshard).sum()
             return e._replace(
                 kvs=e.kvs.at[p, key].set(writer_id(client, rifl)),
                 tbl_pending=e.tbl_pending.at[p, d, kslot].set(False),
+                done_cnt=e.done_cnt.at[p, d].set(done),
+                executed=e.executed.at[p, d].set(done == exp),
                 order_hash=e.order_hash.at[p, key].set(
                     e.order_hash[p, key] * ORDER_HASH_MULT + (d + 1)
                 ),
@@ -184,17 +212,35 @@ def make_executor(n: int) -> ExecutorDef:
                 ready=ready_push(e.ready, p, client, rifl),
             )
 
-        return jax.lax.while_loop(cond, body, est)
+        est = jax.lax.while_loop(cond, body, est)
+
+        # advance the contiguous fully-executed frontier per coordinator
+        fr = ids.advance_frontiers(
+            est.exec_frontier[p], est.vdot[p], est.executed[p], n,
+            ctx.spec.max_seq,
+        )
+        return est._replace(exec_frontier=est.exec_frontier.at[p].set(fr))
 
     def handle(ctx, est: TableExecState, p, info, now):
         kind = info[0]
 
         def attached(est):
             kslot, dot, clock = info[1], info[2], info[3]
-            key = ctx.cmds.keys[dot, kslot]
+            sl = ids.dot_slot(dot, ctx.spec.max_seq)
+            key = ctx.cmds.keys[sl, kslot]
+            fresh = est.vdot[p, sl] != dot
             est = est._replace(
-                tbl_clock=est.tbl_clock.at[p, dot].set(clock),
-                tbl_pending=est.tbl_pending.at[p, dot, kslot].set(True),
+                vdot=est.vdot.at[p, sl].set(dot),
+                tbl_clock=est.tbl_clock.at[p, sl].set(clock),
+                tbl_pending=est.tbl_pending.at[p, sl]
+                .set(est.tbl_pending[p, sl] & ~fresh)
+                .at[p, sl, kslot].set(True),
+                done_cnt=est.done_cnt.at[p, sl].set(
+                    jnp.where(fresh, 0, est.done_cnt[p, sl])
+                ),
+                executed=est.executed.at[p, sl].set(
+                    est.executed[p, sl] & ~fresh
+                ),
             )
             for v in range(n):
                 est = _add_range(est, p, key, v, info[4 + 2 * v], info[5 + 2 * v])
@@ -211,10 +257,34 @@ def make_executor(n: int) -> ExecutorDef:
         ready, res = ready_drain(est.ready, p, ctx.spec.max_res)
         return est._replace(ready=ready), res
 
+    def executed(ctx, est: TableExecState, p):
+        """Per-coordinator contiguous fully-executed frontier (feeds GC
+        window compaction through Protocol::handle_executed)."""
+        return est, est.exec_frontier[p]
+
+    def monitor(ctx, est: TableExecState, p):
+        """monitor_pending (fantoch/src/executor/mod.rs:76-86): snapshot the
+        not-yet-stable table backlog into a high-water gauge."""
+        pending = est.tbl_pending[p].any(axis=-1).sum()
+        return est._replace(
+            pending_max=est.pending_max.at[p].max(pending),
+            monitor_runs=est.monitor_runs.at[p].add(1),
+        )
+
+    def metrics(est: TableExecState):
+        return {
+            "pending_max": est.pending_max,
+            "monitor_runs": est.monitor_runs,
+        }
+
     return ExecutorDef(
         name="table",
         exec_width=EW,
         init=init,
         handle=handle,
         drain=drain,
+        executed_width=n,
+        executed=executed,
+        monitor=monitor,
+        metrics=metrics,
     )

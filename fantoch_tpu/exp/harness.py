@@ -92,6 +92,8 @@ class Point:
     nfr: bool = False
     tempo_tiny_quorums: bool = False
     tempo_clock_bump_interval_ms: int = 0
+    tempo_detached_send_interval_ms: int = 0
+    executor_monitor_pending_interval_ms: int = 0
     skip_fast_ack: bool = False
     execute_at_commit: bool = False
     caesar_wait_condition: bool = True
@@ -127,6 +129,7 @@ def make_protocol_def(
     nfr: bool = False,
     wait_condition: bool = True,
     clock_bump: bool = False,
+    buffer_detached: bool = False,
     tiny_quorums: bool = False,
     skip_fast_ack: bool = False,
     execute_at_commit: bool = False,
@@ -141,7 +144,8 @@ def make_protocol_def(
     if name == "tempo":
         return tempo_proto.make_protocol(
             n, keys_per_command, key_space_hint=key_space_hint, nfr=nfr,
-            clock_bump=clock_bump, skip_fast_ack=skip_fast_ack,
+            clock_bump=clock_bump, buffer_detached=buffer_detached,
+            skip_fast_ack=skip_fast_ack,
         )
     if name == "atlas":
         return atlas_proto.make_protocol(
@@ -185,6 +189,8 @@ def _bucket_key(pt: Point) -> Tuple:
         pt.nfr,
         pt.tempo_tiny_quorums,
         pt.tempo_clock_bump_interval_ms,
+        pt.tempo_detached_send_interval_ms,
+        pt.executor_monitor_pending_interval_ms,
         pt.skip_fast_ack,
         pt.execute_at_commit,
         pt.caesar_wait_condition,
@@ -246,7 +252,15 @@ def run_grid(
         pregions = pregions[:n]
         C = len(client_regions) * pt0.clients_per_region
         wl = pt0.workload()
-        max_seq = C * pt0.commands_per_client
+        total_cmds = C * pt0.commands_per_client
+        # GC window compaction for the protocols that support slot reuse:
+        # per-dot state (and the graph executor's closure) stays sized by
+        # the in-flight window; submits defer (never drop) under pressure.
+        # FPaxos/Caesar run unwindowed (static dot space).
+        if pt0.protocol in ("basic", "tempo", "atlas", "epaxos", "janus"):
+            max_seq = min(total_cmds, max(64, 4 * C))
+        else:
+            max_seq = total_cmds
         pdef = make_protocol_def(
             pt0.protocol,
             n,
@@ -256,6 +270,7 @@ def run_grid(
             nfr=pt0.nfr,
             wait_condition=pt0.caesar_wait_condition,
             clock_bump=pt0.tempo_clock_bump_interval_ms > 0,
+            buffer_detached=pt0.tempo_detached_send_interval_ms > 0,
             skip_fast_ack=pt0.skip_fast_ack,
             execute_at_commit=pt0.execute_at_commit,
         )
@@ -272,6 +287,12 @@ def run_grid(
                 tempo_tiny_quorums=pt.tempo_tiny_quorums,
                 tempo_clock_bump_interval_ms=(
                     pt.tempo_clock_bump_interval_ms or None
+                ),
+                tempo_detached_send_interval_ms=(
+                    pt.tempo_detached_send_interval_ms or None
+                ),
+                executor_monitor_pending_interval_ms=(
+                    pt.executor_monitor_pending_interval_ms or None
                 ),
                 skip_fast_ack=pt.skip_fast_ack,
                 execute_at_commit=pt.execute_at_commit,
@@ -400,20 +421,28 @@ def _append_metrics_snapshot(path: str, bucket: int, st, pdef) -> None:
         f.write(json.dumps(snap) + "\n")
 
 
-def extract_graph_log(st, p: int) -> List[List[int]]:
+def extract_graph_log(st, p: int, max_seq: int) -> List[List[int]]:
     """Pull process `p`'s execution log out of a finished graph-executor run:
-    `[dot, dep, ...]` commit records in arrival order, the same shape
+    `[slot, dep_slot, ...]` commit records in arrival order, the same shape
     `replay_graph_stream` consumes (the reference's execution_logger output
     fed to `graph_executor_replay`, `fantoch_ps/src/bin/
-    graph_executor_replay.rs:13-38`)."""
+    graph_executor_replay.rs:13-38`). `max_seq` is the run's dot window
+    (`SimSpec.max_seq`) — dep values are unbounded dot encodings and map to
+    ring slots through it (exec-log replay is a no-wrap debugging tool)."""
+    from ..core import ids as ids_mod
+
     exec_st = st.exec
     length = int(np.asarray(exec_st.log_len)[p])
     log = np.asarray(exec_st.log_dot)[p, :length]
     deps = np.asarray(exec_st.deps)[p]
     rows: List[List[int]] = []
-    for flat1 in log:
-        dot = int(flat1) - 1
-        row = [dot] + [int(d) - 1 for d in deps[dot] if d > 0]
+    for sl1 in log:
+        sl = int(sl1) - 1
+        row = [sl] + [
+            int(ids_mod.dot_slot(np.int32(d - 1), max_seq))
+            for d in deps[sl]
+            if d > 0
+        ]
         rows.append(row)
     return rows
 
@@ -435,8 +464,11 @@ def replay_graph_stream(rows: Sequence[Sequence[int]], n: int = 1) -> dict:
 
     dots = max(r[0] for r in rows) + 1
     D = max(1, max(len(r) - 1 for r in rows))
+    # slot-space replay: with max_seq >= every slot index, dot_slot is the
+    # identity, so the executor's ring math degenerates to dense indexing
     spec = types.SimpleNamespace(
         dots=dots,
+        max_seq=dots,
         key_space=1,
         keys_per_command=1,
         n_clients=1,

@@ -61,7 +61,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core import workload as workload_mod
-from ..core.ids import dot_flat
+from ..core import ids
 from ..engine.lockstep import Env, SimSpec, message_width
 from ..engine.types import (
     INF_TIME,
@@ -199,6 +199,10 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
     SB = send_slots or max(8 * WC, 64)
     assert SB >= 2 * WC
 
+    assert spec.monitor_ms is None, (
+        "monitor_pending diagnostics are an event-engine feature; disable"
+        " executor_monitor_pending_interval_ms for the distributed runner"
+    )
     intervals = list(spec.proto_periodic_ms)
     exec_notify_slot = None
     if spec.executed_ms is not None:
@@ -545,7 +549,8 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             keys = payload[3 : 3 + KPC]
             seq = st.next_seq[0]
             ok = seq <= spec.max_seq
-            flat = jnp.where(ok, dot_flat(myrow, seq, spec.max_seq), 0)
+            gdot = ids.dot_make(myrow, seq)
+            flat = jnp.where(ok, ids.dot_slot(gdot, spec.max_seq), 0)
             st = st._replace(
                 next_seq=st.next_seq.at[0].add(jnp.where(ok, 1, 0)),
                 dropped=st.dropped.at[0].add(jnp.where(ok, 0, 1)),
@@ -567,7 +572,7 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             # shard (forwarded submits and cross-shard dep requests read the
             # dot's keys from the local command-table replica)
             cmd_payload = pad_payload(
-                [flat, gcid, rifl, ro.astype(jnp.int32)]
+                [gdot, gcid, rifl, ro.astype(jnp.int32)]
                 + [keys[k] for k in range(KPC)]
             )
             others = jnp.int32((1 << n) - 1) & ~(jnp.int32(1) << myrow)
@@ -577,7 +582,7 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             )
             ctx = _ctx(L.st, local_env_view(myrow), myrow)
             pst, outbox, execout = pdef.submit(
-                ctx, L.st.proto, jnp.int32(0), flat, L.st.now
+                ctx, L.st.proto, jnp.int32(0), gdot, L.st.now
             )
             pst = jax.tree_util.tree_map(
                 lambda a, b: jnp.where(ok, a, b), pst, L.st.proto
@@ -660,13 +665,13 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
 
         def b_cmd(L):
             st = L.st
-            dot = payload[0]
+            sl = ids.dot_slot(payload[0], spec.max_seq)
             return L._replace(
                 st=st._replace(
-                    cmd_client=st.cmd_client.at[0, dot].set(payload[1]),
-                    cmd_rifl=st.cmd_rifl.at[0, dot].set(payload[2]),
-                    cmd_ro=st.cmd_ro.at[0, dot].set(payload[3].astype(jnp.bool_)),
-                    cmd_keys=st.cmd_keys.at[0, dot].set(payload[4 : 4 + KPC]),
+                    cmd_client=st.cmd_client.at[0, sl].set(payload[1]),
+                    cmd_rifl=st.cmd_rifl.at[0, sl].set(payload[2]),
+                    cmd_ro=st.cmd_ro.at[0, sl].set(payload[3].astype(jnp.bool_)),
+                    cmd_keys=st.cmd_keys.at[0, sl].set(payload[4 : 4 + KPC]),
                 )
             )
 

@@ -28,7 +28,7 @@ flat dot + 1, 0 = empty):
 - MCOMMIT       [dot, deps x D]
 - MCONSENSUS    [dot, ballot, deps x D]
 - MCONSENSUSACK [dot, ballot]
-- MGC           [frontier_0 .. frontier_{n-1}]
+- MGC           [frontier_0..n-1, stable_0..n-1]
 
 Partial replication (`shards` > 1; reference `protocol/partial.rs` plus the
 atlas.rs MShardCommit handlers and `executor/graph/mod.rs:34-43` dep
@@ -38,6 +38,8 @@ requests) adds:
 - MSHARDAGG  [dot, deps x D]  cross-shard union -> each shard coordinator
 - MDEPREQ    [dot]            executor's missing remote dependency request
 - MDEPREPLY  [dot, deps x D]  the dep's committed deps (RequestReply::Info)
+- MDEPEXEC   [dot]            the dep is already stable here; the requester
+                              marks it executed (RequestReply::Executed)
 """
 from __future__ import annotations
 
@@ -55,7 +57,7 @@ from ..engine.types import (
     empty_outbox,
     outbox_row,
 )
-from ..core.ids import dot_proc
+from ..core import ids
 from ..executors import graph as graph_executor
 from .common import deps as deps_mod
 from .common import gc as gc_mod
@@ -73,6 +75,7 @@ MSHARDC = 7
 MSHARDAGG = 8
 MDEPREQ = 9
 MDEPREPLY = 10
+MDEPEXEC = 11
 
 START = 0
 PAYLOAD = 1
@@ -117,10 +120,10 @@ def _make(
     D = deps_mod.max_union_deps(n, KPC)
     # Janus == Atlas (commit with all deps; README.md:11)
     self_ack = variant != "epaxos"
-    MSG_W = max(2 + D, n)
+    MSG_W = max(2 + D, 2 * n)
     MAX_OUT = 1 if shards == 1 else max(shards + 1, 3)
     MAX_EXEC = 1
-    N_KINDS = 6 if shards == 1 else 11
+    N_KINDS = 6 if shards == 1 else 12
     exdef = graph_executor.make_executor(
         n, D, shards, exec_log=exec_log, execute_at_commit=execute_at_commit
     )
@@ -155,10 +158,11 @@ def _make(
         )
 
     def _add_cmd(ctx, st: AtlasState, p, dot, past, enable):
-        keys = ctx.cmds.keys[dot]
+        sl = ids.dot_slot(dot, ctx.spec.max_seq)
+        keys = ctx.cmds.keys[sl]
         slot_en = sharding.slot_mask(ctx, dot, shards) if shards > 1 else None
         kd, deps, overflow = deps_mod.add_cmd(
-            st.kd, p, dot, keys, ctx.cmds.read_only[dot], past,
+            st.kd, p, dot, keys, ctx.cmds.read_only[sl], past,
             st.dep_overflow[p], enable, nfr, slot_en=slot_en,
         )
         return st._replace(
@@ -169,12 +173,13 @@ def _make(
         """Commit path (atlas.rs:392-453): mark COMMIT, hand the dep set to
         the graph executor, record for GC; answer dep requests that were
         buffered waiting for this commit (buffered_in_requests)."""
+        sl = ids.dot_slot(dot, ctx.spec.max_seq)
         st = st._replace(
-            status=st.status.at[p, dot].set(
-                jnp.where(enable, COMMIT, st.status[p, dot])
+            status=st.status.at[p, sl].set(
+                jnp.where(enable, COMMIT, st.status[p, sl])
             ),
-            acc_deps=st.acc_deps.at[p, dot].set(
-                jnp.where(enable, deps, st.acc_deps[p, dot])
+            acc_deps=st.acc_deps.at[p, sl].set(
+                jnp.where(enable, deps, st.acc_deps[p, sl])
             ),
             commit_count=st.commit_count.at[p].add(enable.astype(jnp.int32)),
             gc=gc_mod.gc_commit(
@@ -184,13 +189,13 @@ def _make(
             ),
         )
         if shards > 1 and ob is not None:
-            pending = st.reqpend[p, dot]
+            pending = st.reqpend[p, sl]
             ob = outbox_row(
                 ob, row, enable & (pending != 0), pending, MDEPREPLY,
                 [dot] + list(deps),
             )
             st = st._replace(
-                reqpend=st.reqpend.at[p, dot].set(
+                reqpend=st.reqpend.at[p, sl].set(
                     jnp.where(enable, 0, pending)
                 )
             )
@@ -212,7 +217,7 @@ def _make(
         ob = outbox_row(
             ob, row, enable & single, ctx.env.all_mask[p], MCOMMIT, pay
         )
-        agg = dot_proc(dot, ctx.spec.max_seq)
+        agg = ids.dot_proc(dot)
         return outbox_row(
             ob, row + 1, enable & ~single, jnp.int32(1) << agg, MSHARDC, pay
         )
@@ -239,8 +244,10 @@ def _make(
 
     def h_mcollect(ctx, st: AtlasState, p, src, payload, now):
         dot, qmask = payload[0], payload[1]
+        sl = ids.dot_slot(dot, ctx.spec.max_seq)
+        live = gc_mod.gc_live(st.gc, p, dot)
         rdeps = payload[2 : 2 + D]
-        is_start = st.status[p, dot] == START
+        is_start = live & (st.status[p, sl] == START)
         in_q = bit(qmask, ctx.pid) == 1
         from_self = src == ctx.pid
         q_en = is_start & in_q
@@ -255,18 +262,18 @@ def _make(
             qsz = qsz + bit(qmask, jnp.int32(i))
         if not self_ack:
             qsz = qsz - 1  # EPaxosInfo: coordinator's deps aren't counted
-        not_accepted = st.synod.acc_abal[p, dot] == 0
+        not_accepted = st.synod.acc_abal[p, sl] == 0
         st = st._replace(
-            status=st.status.at[p, dot].set(
+            status=st.status.at[p, sl].set(
                 jnp.where(
                     is_start,
                     jnp.where(in_q, COLLECT, PAYLOAD),
-                    st.status[p, dot],
+                    st.status[p, sl],
                 )
             ),
-            qsize=st.qsize.at[p, dot].set(jnp.where(q_en, qsz, st.qsize[p, dot])),
-            acc_deps=st.acc_deps.at[p, dot].set(
-                jnp.where(q_en & not_accepted, deps, st.acc_deps[p, dot])
+            qsize=st.qsize.at[p, sl].set(jnp.where(q_en, qsz, st.qsize[p, sl])),
+            acc_deps=st.acc_deps.at[p, sl].set(
+                jnp.where(q_en & not_accepted, deps, st.acc_deps[p, sl])
             ),
         )
         ack_en = q_en if self_ack else (q_en & ~from_self)
@@ -275,40 +282,42 @@ def _make(
             ack_en, jnp.int32(1) << src, MCOLLECTACK, [dot] + list(deps),
         )
         # non-quorum member: payload only; flush a buffered commit
-        flush = is_start & ~in_q & st.bufc_valid[p, dot]
+        flush = is_start & ~in_q & st.bufc_valid[p, sl]
         st = st._replace(
-            bufc_valid=st.bufc_valid.at[p, dot].set(st.bufc_valid[p, dot] & ~flush)
+            bufc_valid=st.bufc_valid.at[p, sl].set(st.bufc_valid[p, sl] & ~flush)
         )
         st, execout, ob = _commit(
-            ctx, st, p, dot, st.bufc_deps[p, dot], flush, ob=ob, row=1
+            ctx, st, p, dot, st.bufc_deps[p, sl], flush, ob=ob, row=1
         )
         return st, ob, execout
 
     def h_mcollectack(ctx, st: AtlasState, p, src, payload, now):
         dot = payload[0]
+        sl = ids.dot_slot(dot, ctx.spec.max_seq)
+        live = gc_mod.gc_live(st.gc, p, dot)
         rdeps = payload[1 : 1 + D]
-        collect = st.status[p, dot] == COLLECT
-        st = st._replace(qd=deps_mod.quorumdeps_add(st.qd, p, dot, rdeps, collect))
+        collect = live & (st.status[p, sl] == COLLECT)
+        st = st._replace(qd=deps_mod.quorumdeps_add(st.qd, p, sl, rdeps, collect))
 
-        count = st.qd.count[p, dot]
-        all_in = collect & (count == st.qsize[p, dot])
+        count = st.qd.count[p, sl]
+        all_in = collect & (count == st.qsize[p, sl])
         if self_ack:
             # Atlas: every dep reported >= quorum - minority times (the
             # minority of this shard's replica group, config.rs:295-302)
-            threshold = st.qsize[p, dot] - ranks // 2
+            threshold = st.qsize[p, sl] - ranks // 2
         else:
             # EPaxos: all counted members reported identical deps
-            threshold = st.qsize[p, dot]
-        union, thr_ok = deps_mod.quorumdeps_check(st.qd, p, dot, threshold)
+            threshold = st.qsize[p, sl]
+        union, thr_ok = deps_mod.quorumdeps_check(st.qd, p, sl, threshold)
         fast = all_in & thr_ok
         slow = all_in & ~thr_ok
 
         st = st._replace(
             synod=synod_mod.skip_prepare(
-                st.synod, p, dot, jnp.int32(0), slow, pid=ctx.pid
+                st.synod, p, sl, jnp.int32(0), slow, pid=ctx.pid
             ),
-            prop_deps=st.prop_deps.at[p, dot].set(
-                jnp.where(slow, union, st.prop_deps[p, dot])
+            prop_deps=st.prop_deps.at[p, sl].set(
+                jnp.where(slow, union, st.prop_deps[p, sl])
             ),
             fast_count=st.fast_count.at[p].add(fast.astype(jnp.int32)),
             slow_count=st.slow_count.at[p].add(slow.astype(jnp.int32)),
@@ -338,13 +347,17 @@ def _make(
 
     def h_mcommit(ctx, st: AtlasState, p, src, payload, now):
         dot = payload[0]
+        sl = ids.dot_slot(dot, ctx.spec.max_seq)
+        live = gc_mod.gc_live(st.gc, p, dot)
         deps = payload[1 : 1 + D]
-        is_start = st.status[p, dot] == START
-        can_commit = (st.status[p, dot] == PAYLOAD) | (st.status[p, dot] == COLLECT)
+        is_start = live & (st.status[p, sl] == START)
+        can_commit = live & (
+            (st.status[p, sl] == PAYLOAD) | (st.status[p, sl] == COLLECT)
+        )
         st = st._replace(
-            bufc_valid=st.bufc_valid.at[p, dot].set(st.bufc_valid[p, dot] | is_start),
-            bufc_deps=st.bufc_deps.at[p, dot].set(
-                jnp.where(is_start, deps, st.bufc_deps[p, dot])
+            bufc_valid=st.bufc_valid.at[p, sl].set(st.bufc_valid[p, sl] | is_start),
+            bufc_deps=st.bufc_deps.at[p, sl].set(
+                jnp.where(is_start, deps, st.bufc_deps[p, sl])
             ),
         )
         st, execout, ob = _commit(
@@ -355,20 +368,23 @@ def _make(
 
     def h_mconsensus(ctx, st: AtlasState, p, src, payload, now):
         dot, ballot = payload[0], payload[1]
+        sl = ids.dot_slot(dot, ctx.spec.max_seq)
+        live = gc_mod.gc_live(st.gc, p, dot)
         deps = payload[2 : 2 + D]
-        chosen = st.status[p, dot] == COMMIT
-        sy, accepted = synod_mod.handle_accept(st.synod, p, dot, ballot, jnp.int32(0))
+        chosen = live & (st.status[p, sl] == COMMIT)
+        sy, accepted = synod_mod.handle_accept(st.synod, p, sl, ballot, jnp.int32(0))
+        accepted = accepted & live
         take = ~chosen & accepted
         st = st._replace(
             synod=jax.tree_util.tree_map(
-                lambda a, b: jnp.where(chosen, a, b), st.synod, sy
+                lambda a, b: jnp.where(chosen | ~live, a, b), st.synod, sy
             ),
-            acc_deps=st.acc_deps.at[p, dot].set(
-                jnp.where(take, deps, st.acc_deps[p, dot])
+            acc_deps=st.acc_deps.at[p, sl].set(
+                jnp.where(take, deps, st.acc_deps[p, sl])
             ),
         )
         # already chosen: reply MCommit with the chosen deps (atlas.rs:489-492)
-        commit_payload = jnp.concatenate([dot[None], st.acc_deps[p, dot]])
+        commit_payload = jnp.concatenate([dot[None], st.acc_deps[p, sl]])
         ack_payload = jnp.concatenate(
             [dot[None], ballot[None], jnp.zeros((D - 1,), jnp.int32)]
         )
@@ -384,26 +400,62 @@ def _make(
 
     def h_mconsensusack(ctx, st: AtlasState, p, src, payload, now):
         dot, ballot = payload[0], payload[1]
-        not_committed = st.status[p, dot] != COMMIT
+        sl = ids.dot_slot(dot, ctx.spec.max_seq)
+        live = gc_mod.gc_live(st.gc, p, dot)
+        not_committed = live & (st.status[p, sl] != COMMIT)
         sy, chosen, _ = synod_mod.handle_accepted(
-            st.synod, p, dot, ballot, ctx.env.wq_size, src
+            st.synod, p, sl, ballot, ctx.env.wq_size, src
         )
         chosen = chosen & not_committed
-        st = st._replace(synod=sy)
+        st = st._replace(
+            synod=jax.tree_util.tree_map(
+                lambda a, b: jnp.where(live, a, b), sy, st.synod
+            )
+        )
         ob = _commit_or_aggregate(
             ctx, st, empty_outbox(MAX_OUT, MSG_W), 0, p, dot,
-            st.prop_deps[p, dot], chosen,
+            st.prop_deps[p, sl], chosen,
         )
         return st, ob, empty_execout(MAX_EXEC, EW)
 
     def h_mgc(ctx, st: AtlasState, p, src, payload, now):
-        st = st._replace(
-            gc=gc_mod.gc_handle_mgc(
-                st.gc, p, src, payload[:n], pid=ctx.pid,
-                peers_mask=ctx.env.all_mask[p],
-            )
+        gc, cleared = gc_mod.gc_handle_mgc(
+            st.gc, p, src, payload[:n], payload[n:2 * n],
+            ctx.spec.max_seq, pid=ctx.pid,
+            peers_mask=ctx.env.all_mask[p],
         )
+        st = _clear_slots(st._replace(gc=gc), p, cleared)
         return st, empty_outbox(MAX_OUT, MSG_W), empty_execout(MAX_EXEC, EW)
+
+    def _clear_slots(st: AtlasState, p, cleared):
+        """Recycle newly-stable ring slots: zero every per-dot leaf of row
+        `p` (the reference deletes stable dots from its registries)."""
+        rows = st.status.shape[0]  # 1 under the row convention, n otherwise
+        rowm = jnp.arange(rows)[:, None] == p  # [rows, 1]
+        cm = rowm & cleared[None, :]  # [rows, DOTS]
+        z2 = lambda x: jnp.where(cm, 0, x) if x.dtype != jnp.bool_ else x & ~cm
+        z3 = lambda x: jnp.where(cm[:, :, None], 0, x)
+        sy = st.synod
+        sy = type(sy)(*(z2(leaf) for leaf in sy))
+        st = st._replace(
+            status=z2(st.status),
+            qsize=z2(st.qsize),
+            qd=st.qd._replace(
+                count=z2(st.qd.count), dep=z3(st.qd.dep), cnt=z3(st.qd.cnt)
+            ),
+            acc_deps=z3(st.acc_deps),
+            prop_deps=z3(st.prop_deps),
+            synod=sy,
+            bufc_valid=z2(st.bufc_valid),
+            bufc_deps=z3(st.bufc_deps),
+        )
+        if shards > 1:
+            st = st._replace(
+                sc_cnt=z2(st.sc_cnt),
+                sc_deps=z3(st.sc_deps),
+                reqpend=z2(st.reqpend),
+            )
+        return st
 
     def h_mfwd(ctx, st: AtlasState, p, src, payload, now):
         """MForwardSubmit at this shard's designated coordinator: compute the
@@ -425,21 +477,22 @@ def _make(
         back to each shard's coordinator (partial.rs handle_mshard_commit +
         atlas.rs add_shards_commits_info extending the dep set)."""
         dot = payload[0]
+        sl = ids.dot_slot(dot, ctx.spec.max_seq)
         rdeps = payload[1 : 1 + D]
         # capacity: the union of all shards' sets fits one D-row because each
         # shard contributes deps only for keys it owns (slot_en in add_cmd),
         # so across shards the per-key contributions are disjoint and the
         # total is bounded by sum over keys of 2*(ranks+1) <= D
-        row = st.sc_deps[p, dot]
+        row = st.sc_deps[p, sl]
         overflow = st.dep_overflow[p]
         for j in range(D):
             row, overflow = deps_mod.set_insert(
                 row, rdeps[j], jnp.bool_(True), overflow
             )
-        cnt = st.sc_cnt[p, dot] + 1
+        cnt = st.sc_cnt[p, sl] + 1
         st = st._replace(
-            sc_cnt=st.sc_cnt.at[p, dot].set(cnt),
-            sc_deps=st.sc_deps.at[p, dot].set(row),
+            sc_cnt=st.sc_cnt.at[p, sl].set(cnt),
+            sc_deps=st.sc_deps.at[p, sl].set(row),
             dep_overflow=st.dep_overflow.at[p].set(overflow),
         )
         touch = sharding.shard_touch(ctx, dot, shards)
@@ -468,19 +521,27 @@ def _make(
     def h_mdepreq(ctx, st: AtlasState, p, src, payload, now):
         """A remote executor asks for a dependency of ours it cannot see
         (executor/graph Request). Reply Info{dot, deps} if committed here;
-        otherwise buffer the requester until the commit arrives."""
+        if the dot is already STABLE (its slot recycled by GC), reply
+        Executed so the requester marks the dependency satisfied
+        (`RequestReply::Executed`, executor/graph/mod.rs:34-43); otherwise
+        buffer the requester until the commit arrives."""
         dot = payload[0]
-        committed = st.status[p, dot] == COMMIT
+        sl = ids.dot_slot(dot, ctx.spec.max_seq)
+        live = gc_mod.gc_live(st.gc, p, dot)
+        committed = live & (st.status[p, sl] == COMMIT)
         ob = outbox_row(
             empty_outbox(MAX_OUT, MSG_W), 0,
             committed, jnp.int32(1) << src, MDEPREPLY,
-            [dot] + list(st.acc_deps[p, dot]),
+            [dot] + list(st.acc_deps[p, sl]),
+        )
+        ob = outbox_row(
+            ob, 1, ~live, jnp.int32(1) << src, MDEPEXEC, [dot]
         )
         st = st._replace(
-            reqpend=st.reqpend.at[p, dot].set(
+            reqpend=st.reqpend.at[p, sl].set(
                 jnp.where(
-                    committed, st.reqpend[p, dot],
-                    st.reqpend[p, dot] | (jnp.int32(1) << src),
+                    committed | ~live, st.reqpend[p, sl],
+                    st.reqpend[p, sl] | (jnp.int32(1) << src),
                 )
             ),
             in_requests=st.in_requests.at[p].add(1),
@@ -498,6 +559,18 @@ def _make(
         )
         return st, empty_outbox(MAX_OUT, MSG_W), execout
 
+    def h_mdepexec(ctx, st: AtlasState, p, src, payload, now):
+        """RequestReply::Executed — the dep is stable at its home shard, so
+        every process executed it; mark it executed locally (negative-dot
+        execution info, executors/graph.py handle)."""
+        dot = payload[0]
+        info = jnp.zeros((1 + D,), jnp.int32).at[0].set(-(dot + 1))
+        execout = ExecOut(
+            valid=jnp.ones((MAX_EXEC,), jnp.bool_),
+            info=info[None, :],
+        )
+        return st, empty_outbox(MAX_OUT, MSG_W), execout
+
     def handle(ctx, st, p, src, kind, payload, now):
         hs = [
             h_mcollect,
@@ -508,31 +581,37 @@ def _make(
             h_mgc,
         ]
         if shards > 1:
-            hs += [h_mfwd, h_mshardc, h_mshardagg, h_mdepreq, h_mdepreply]
+            hs += [h_mfwd, h_mshardc, h_mshardagg, h_mdepreq, h_mdepreply,
+                   h_mdepexec]
         branches = [functools.partial(h, ctx) for h in hs]
         return jax.lax.switch(kind, branches, st, p, src, payload, now)
 
     def handle_executed(ctx, st: AtlasState, p, info, now):
-        """Turn the executor's missing-remote-dep dots into MDEPREQ messages
-        addressed to the closest process of each dep's first touched shard
-        (DependencyGraph::out_requests drained to the network)."""
+        """Fold the executor's executed frontier into GC (window compaction)
+        and — under partial replication — turn its missing-remote-dep dots
+        into MDEPREQ messages addressed to the closest process of each dep's
+        first touched shard (DependencyGraph::out_requests drained)."""
+        st = st._replace(gc=gc_mod.gc_note_exec(st.gc, p, info[:n]))
+        if shards == 1:
+            return st, empty_outbox(1, MSG_W)
         ob = empty_outbox(graph_executor.MAX_REQS, MSG_W)
         for i in range(graph_executor.MAX_REQS):
-            dot = info[i] - 1
-            en = info[i] > 0
-            safe = jnp.clip(dot, 0, ctx.spec.dots - 1)
-            touch = sharding.shard_touch(ctx, safe, shards)
+            dot = info[n + i] - 1
+            en = info[n + i] > 0
+            touch = sharding.shard_touch(ctx, jnp.maximum(dot, 0), shards)
             t = jnp.argmax(touch).astype(jnp.int32)
             tgt = jnp.int32(1) << ctx.env.closest_shard_proc[p, t]
-            ob = outbox_row(ob, i, en, tgt, MDEPREQ, [safe])
+            ob = outbox_row(ob, i, en, tgt, MDEPREQ, [jnp.maximum(dot, 0)])
         return st, ob
 
     def periodic(ctx, st: AtlasState, p, kind, now):
         all_but_me = ctx.env.all_mask[p] & ~(jnp.int32(1) << ctx.pid)
-        row = gc_mod.gc_frontier_row(st.gc, p)
+        row = gc_mod.gc_report_row(st.gc, p)
+        wm = gc_mod.gc_stable_row(st.gc, p)
         ob = outbox_row(
             empty_outbox(1, MSG_W), 0,
-            jnp.bool_(True), all_but_me, MGC, [row[a] for a in range(n)],
+            jnp.bool_(True), all_but_me, MGC,
+            [row[a] for a in range(n)] + [wm[a] for a in range(n)],
         )
         return st, ob
 
@@ -564,7 +643,10 @@ def _make(
         handle=handle,
         periodic_events=(("garbage_collection", lambda cfg: cfg.gc_interval_ms),),
         periodic=periodic,
-        handle_executed=handle_executed if shards > 1 else None,
+        handle_executed=handle_executed,
+        window_floor=(
+            (lambda pstate: gc_mod.gc_floor(pstate.gc)) if shards == 1 else None
+        ),
         quorum_sizes=quorum_sizes,
         leaderless=True,
         shards=shards,

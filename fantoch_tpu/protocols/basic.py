@@ -14,13 +14,17 @@ to validate the execution engines:
   module, see `protocols/common/gc.py`).
 
 Device layout: per-process per-dot bits (`has_cmd`, `acks`,
-`buffered_commit`) in `[n, DOTS]` tensors.
+`buffered_commit`) in `[n, DOTS]` ring-slot tensors (`core/ids.py
+dot_slot`); newly-stable slots are cleared and recycled (GC window
+compaction, `protocols/common/gc.py`), so state is sized by the in-flight
+window, not the run length.
 
-Message kinds/payloads (int32 rows):
+Message kinds/payloads (int32 rows; dots are unbounded `dot_make`
+encodings):
 - MSTORE    [dot, quorum_mask]
 - MSTOREACK [dot]
 - MCOMMIT   [dot]
-- MGC       [frontier_0 .. frontier_{n-1}]
+- MGC       [frontier_0..n-1, stable_0..n-1]
 """
 from __future__ import annotations
 
@@ -30,6 +34,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..core import ids
 from ..engine.types import (
     ExecOut,
     ProtocolDef,
@@ -66,7 +71,7 @@ def make_protocol(n: int, keys_per_command: int = 1, shards: int = 1) -> Protoco
     replica executes only its own shard's keys (`basic.rs:264`
     `cmd.iter(self.bp.shard_id)`)."""
     KPC = keys_per_command
-    MSG_W = max(2, n)
+    MSG_W = max(2, 2 * n)
     # submit row 0 = MStore; rows 1..shards = one (statically allocated)
     # forward row per shard, inert for the submitter's own shard
     MAX_OUT = 2 if shards == 1 else 1 + shards
@@ -119,15 +124,16 @@ def make_protocol(n: int, keys_per_command: int = 1, shards: int = 1) -> Protoco
     def _commit(ctx, st: BasicState, p, dot, enable):
         """Commit path (basic.rs:251-282): emit per-key execution infos and
         record the dot as committed (inlines the self-forwarded MCommitDot)."""
+        sl = ids.dot_slot(dot, ctx.spec.max_seq)
         execout = ExecOut(
             valid=jnp.broadcast_to(enable, (MAX_EXEC,)) & _shard_slot_mask(ctx, dot),
             info=jnp.stack(
                 [
                     jnp.stack(
                         [
-                            ctx.cmds.client[dot],
-                            ctx.cmds.rifl_seq[dot],
-                            ctx.cmds.keys[dot, k],
+                            ctx.cmds.client[sl],
+                            ctx.cmds.rifl_seq[sl],
+                            ctx.cmds.keys[sl, k],
                         ]
                     )
                     for k in range(KPC)
@@ -146,40 +152,66 @@ def make_protocol(n: int, keys_per_command: int = 1, shards: int = 1) -> Protoco
 
     def h_mstore(ctx, st: BasicState, p, src, payload, now):
         dot, quorum_mask = payload[0], payload[1]
-        st = st._replace(has_cmd=st.has_cmd.at[p, dot].set(True))
-        in_quorum = bit(quorum_mask, ctx.pid) == 1
+        sl = ids.dot_slot(dot, ctx.spec.max_seq)
+        live = gc_mod.gc_live(st.gc, p, dot)
+        st = st._replace(
+            has_cmd=st.has_cmd.at[p, sl].set(st.has_cmd[p, sl] | live)
+        )
+        in_quorum = live & (bit(quorum_mask, ctx.pid) == 1)
         ob = _outbox1(in_quorum, jnp.int32(1) << src, MSTOREACK, [dot])
         # flush a buffered commit now that the payload arrived
-        buffered = st.buffered_commit[p, dot]
-        st = st._replace(buffered_commit=st.buffered_commit.at[p, dot].set(False))
+        buffered = live & st.buffered_commit[p, sl]
+        st = st._replace(
+            buffered_commit=st.buffered_commit.at[p, sl].set(
+                st.buffered_commit[p, sl] & ~live
+            )
+        )
         st, execout = _commit(ctx, st, p, dot, buffered)
         return st, ob, execout
 
     def h_mstoreack(ctx, st: BasicState, p, src, payload, now):
         dot = payload[0]
-        acks = st.acks[p, dot] + 1
-        st = st._replace(acks=st.acks.at[p, dot].set(acks))
+        sl = ids.dot_slot(dot, ctx.spec.max_seq)
+        live = gc_mod.gc_live(st.gc, p, dot)
+        acks = st.acks[p, sl] + 1
+        st = st._replace(
+            acks=st.acks.at[p, sl].set(jnp.where(live, acks, st.acks[p, sl]))
+        )
         # all replies in: commit (basic.rs:237-248)
-        ob = _outbox1(acks == ctx.env.fq_size, ctx.env.all_mask[p], MCOMMIT, [dot])
+        ob = _outbox1(
+            live & (acks == ctx.env.fq_size), ctx.env.all_mask[p], MCOMMIT, [dot]
+        )
         return st, ob, empty_execout(MAX_EXEC, EW)
 
     def h_mcommit(ctx, st: BasicState, p, src, payload, now):
         dot = payload[0]
-        has = st.has_cmd[p, dot]
+        sl = ids.dot_slot(dot, ctx.spec.max_seq)
+        live = gc_mod.gc_live(st.gc, p, dot)
+        has = live & st.has_cmd[p, sl]
         st = st._replace(
-            buffered_commit=st.buffered_commit.at[p, dot].set(
-                st.buffered_commit[p, dot] | ~has
+            buffered_commit=st.buffered_commit.at[p, sl].set(
+                st.buffered_commit[p, sl] | (live & ~has)
             )
         )
         st, execout = _commit(ctx, st, p, dot, has)
         return st, empty_outbox(MAX_OUT, MSG_W), execout
 
     def h_mgc(ctx, st: BasicState, p, src, payload, now):
+        gc, cleared = gc_mod.gc_handle_mgc(
+            st.gc, p, src, payload[:n], payload[n:2 * n],
+            ctx.spec.max_seq, pid=ctx.pid,
+            peers_mask=ctx.env.all_mask[p],
+        )
+        # recycle newly-stable ring slots (the reference deletes stable dots
+        # from its per-dot registries, basic.rs MStable handling)
+        keep = ~cleared[None, :]
         st = st._replace(
-            gc=gc_mod.gc_handle_mgc(
-                st.gc, p, src, payload[:n], pid=ctx.pid,
-                peers_mask=ctx.env.all_mask[p],
-            )
+            gc=gc,
+            has_cmd=st.has_cmd & jnp.where(jnp.arange(st.has_cmd.shape[0])[:, None] == p, keep, True),
+            acks=jnp.where((jnp.arange(st.acks.shape[0])[:, None] == p) & cleared[None, :], 0, st.acks),
+            buffered_commit=st.buffered_commit & jnp.where(
+                jnp.arange(st.buffered_commit.shape[0])[:, None] == p, keep, True
+            ),
         )
         return st, empty_outbox(MAX_OUT, MSG_W), empty_execout(MAX_EXEC, EW)
 
@@ -193,8 +225,12 @@ def make_protocol(n: int, keys_per_command: int = 1, shards: int = 1) -> Protoco
     def periodic(ctx, st: BasicState, p, kind, now):
         # GarbageCollection: broadcast own committed clock (basic.rs:320-331)
         all_but_me = ctx.env.all_mask[p] & ~(jnp.int32(1) << ctx.pid)
-        row = gc_mod.gc_frontier_row(st.gc, p)
-        ob = _outbox1(jnp.bool_(True), all_but_me, MGC, [row[a] for a in range(n)])
+        row = gc_mod.gc_report_row(st.gc, p)
+        wm = gc_mod.gc_stable_row(st.gc, p)
+        ob = _outbox1(
+            jnp.bool_(True), all_but_me, MGC,
+            [row[a] for a in range(n)] + [wm[a] for a in range(n)],
+        )
         return st, ob
 
     def metrics(st: BasicState):
@@ -215,6 +251,9 @@ def make_protocol(n: int, keys_per_command: int = 1, shards: int = 1) -> Protoco
         handle=handle,
         periodic_events=(("garbage_collection", lambda cfg: cfg.gc_interval_ms),),
         periodic=periodic,
+        window_floor=(
+            (lambda pstate: gc_mod.gc_floor(pstate.gc)) if shards == 1 else None
+        ),
         quorum_sizes=lambda cfg: (cfg.basic_quorum_size(), 0, 0),
         leaderless=True,
         shards=shards,

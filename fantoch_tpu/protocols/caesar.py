@@ -54,7 +54,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from ..core.ids import dot_proc
+from ..core import ids
 from ..engine.types import (
     ExecOut,
     ProtocolDef,
@@ -231,6 +231,11 @@ def make_protocol(
     # ------------------------------------------------------------------
 
     def submit(ctx, st: CaesarState, p, dot, now):
+        # Caesar runs without GC window compaction (its dep bitmaps are
+        # slot-indexed): the engine's static window guard makes dot <-> slot
+        # a bijection, so the whole protocol + predecessors executor work in
+        # slot space; only this engine boundary converts
+        dot = ids.dot_slot(dot, ctx.spec.max_seq)
         st, clock = _clock_next(st, p, ctx.pid, True)
         ob = outbox_row(
             empty_outbox(MAX_OUT, MSG_W), 0,
@@ -405,7 +410,7 @@ def make_protocol(
         st = st._replace(
             commit_lat_hist=hist_add(
                 st.commit_lat_hist, p, now - st.start_ms[p, dot],
-                can & (mfrom == dot_proc(dot, max_seq)),
+                can & (mfrom == ids.slot_coord(dot, max_seq)),
             ),
             deps_len_hist=hist_add(
                 st.deps_len_hist, p, bm_count(rdeps), can
@@ -544,7 +549,7 @@ def make_protocol(
         )
         ack_clock = jnp.where(do_rej, new_clock, st.clock_of[p, wc])
         ack_deps = jnp.where(do_rej, nack_deps, st.deps[p, wc])
-        coord = dot_proc(wc, max_seq)
+        coord = ids.slot_coord(wc, max_seq)
         ob = outbox_row(
             empty_outbox(MAX_OUT, MSG_W), 0,
             do_acc | do_rej, jnp.int32(1) << coord, MPROPOSEACK,

@@ -1,21 +1,39 @@
-"""Commit-tracking garbage collection shared by protocols.
+"""Commit-tracking garbage collection shared by protocols — with window
+compaction.
 
 Reference parity: `fantoch/src/protocol/gc/clock.rs` (`VClockGCTrack`) and its
 use in every protocol's `MCommitDot` / `MGarbageCollection` / `MStable`
 handlers (e.g. `fantoch/src/protocol/basic.rs:284-331`):
 
-- each process records locally-committed dots (an `AEClock` — here a dense
-  committed bitmap + per-coordinator contiguous frontier);
-- a periodic event broadcasts the committed frontier to all peers;
+- each process records locally-committed dots (an `AEClock` — here
+  generation-tagged ring slots + per-coordinator contiguous frontier);
+- a periodic event broadcasts the frontier to all peers;
 - on receipt, peers join clocks (element-wise max) and compute the *stable*
   frontier = meet across all processes (undefined until every peer has
   reported once);
 - newly-stable dots beyond the previous watermark are counted into the
-  `Stable` metric (the reference counts dots removed by `cmds.gc`; dot
-  windows make that the same number).
+  `Stable` metric (the reference counts dots removed by `cmds.gc`; windows
+  make that the same number).
 
-State layout: leading process axis `n`; dots flattened as
-`coordinator * max_seq + (seq-1)`.
+Where the reference's GC *deletes* stable dots from its per-dot HashMaps
+(bounding memory), here stability *recycles ring slots*
+(`core/ids.py dot_slot`): per-dot state is `[n, n*W]` with `W` slots per
+coordinator, and newly-stable slots are cleared so the coordinator can reuse
+them for sequence `s + W`. Three additions make the recycling safe:
+
+1. the broadcast frontier is `min(committed, executed)` per coordinator —
+   a dot only stabilizes once every process *executed* it, so executor
+   per-dot state (graph vertices, table entries) is recyclable too;
+2. peers also gossip their stable *watermarks*; the engine's allocation
+   window floor (`ProtocolDef.window_floor`) is the meet of everyone's
+   REPORTED watermark, so by the time a coordinator reuses a slot every
+   process has already computed stability and cleared it — no message of
+   the new generation can reach uncleared state;
+3. handlers drop stragglers referencing dead generations with `gc_live`
+   (a dot at or below the local stable watermark).
+
+State layout: leading process axis `n`; slots as
+`coordinator * W + (seq-1) % W`.
 """
 from __future__ import annotations
 
@@ -26,73 +44,154 @@ import jax.numpy as jnp
 
 from ...core import ids
 
+_INF = jnp.int32(2**30)
+
 
 class GCTrack(NamedTuple):
-    committed: jnp.ndarray  # [n, DOTS] bool
+    cdot: jnp.ndarray  # [n, DOTS] int32 committed generation per ring slot
+    # (-1 = none; the tag disambiguates ring aliasing: an uncleared old
+    # generation's entry never matches the next generation's probe)
     frontier: jnp.ndarray  # [n, n] int32 own contiguous committed per coordinator
+    exec_frontier: jnp.ndarray  # [n, n] int32 own contiguous executed per
+    # coordinator (INF when execution == commit, e.g. Basic)
     clock_of: jnp.ndarray  # [n, n, n] int32 peers' reported frontiers
     heard_from: jnp.ndarray  # [n, n] bool
-    stable_wm: jnp.ndarray  # [n, n] int32 previous stable watermark
+    stable_wm: jnp.ndarray  # [n, n] int32 own stable watermark per coordinator
+    stable_of: jnp.ndarray  # [n, n, n] int32 peers' reported stable watermarks
     stable_count: jnp.ndarray  # [n] int32 Stable metric
 
 
 def gc_init(n: int, dots: int) -> GCTrack:
     return GCTrack(
-        committed=jnp.zeros((n, dots), jnp.bool_),
+        cdot=jnp.full((n, dots), -1, jnp.int32),
         frontier=jnp.zeros((n, n), jnp.int32),
+        exec_frontier=jnp.full((n, n), _INF, jnp.int32),
         clock_of=jnp.zeros((n, n, n), jnp.int32),
         heard_from=jnp.zeros((n, n), jnp.bool_),
         stable_wm=jnp.zeros((n, n), jnp.int32),
+        stable_of=jnp.zeros((n, n, n), jnp.int32),
         stable_count=jnp.zeros((n,), jnp.int32),
     )
 
 
-def gc_commit(gc: GCTrack, p, dot, enable, max_seq: int) -> GCTrack:
+def gc_commit(gc: GCTrack, p, dot, enable, window: int) -> GCTrack:
     """Record a committed dot (the inlined `MCommitDot` self-forward) and
     advance the contiguous frontier for the dot's coordinator."""
-    committed = gc.committed.at[p, dot].set(gc.committed[p, dot] | enable)
-    a = ids.dot_proc(dot, max_seq)
+    sl = ids.dot_slot(dot, window)
+    cdot = gc.cdot.at[p, sl].set(jnp.where(enable, dot, gc.cdot[p, sl]))
+    a = ids.dot_proc(dot)
 
     def adv_cond(fr):
-        return (fr < max_seq) & committed[p, a * max_seq + jnp.clip(fr, 0, max_seq - 1)]
+        # seq fr+1 lives at ring slot fr % window; the generation tag keeps
+        # a stale (not-yet-recycled) occupant from aliasing as fr+1
+        return (
+            cdot[p, a * window + fr % window] == ids.dot_make(a, fr + 1)
+        ) & (fr < gc.frontier[p, a] + window)
 
     fr = jax.lax.while_loop(adv_cond, lambda fr: fr + 1, gc.frontier[p, a])
     return gc._replace(
-        committed=committed,
+        cdot=cdot,
         frontier=gc.frontier.at[p, a].set(jnp.where(enable, fr, gc.frontier[p, a])),
     )
 
 
-def gc_handle_mgc(gc: GCTrack, p, src, incoming: jnp.ndarray, pid=None,
-                  peers_mask=None) -> GCTrack:
-    """Join a peer's committed clock and fold newly-stable dots into the
-    Stable metric (inlines the `MStable` self-forward).
+def gc_note_exec(gc: GCTrack, p, exec_frontier_row: jnp.ndarray) -> GCTrack:
+    """Fold the paired executor's contiguous executed frontier (per
+    coordinator) into the report — the `Executor::executed` →
+    `Protocol::handle_executed` channel (`fantoch/src/executor/mod.rs:74-82`)."""
+    old = gc.exec_frontier[p]
+    return gc._replace(
+        exec_frontier=gc.exec_frontier.at[p].set(
+            # INF marks "never reported" (execution == commit); frontiers
+            # only grow once reporting starts
+            jnp.where(old == _INF, exec_frontier_row, jnp.maximum(old, exec_frontier_row))
+        )
+    )
+
+
+def gc_report_row(gc: GCTrack, p) -> jnp.ndarray:
+    """Frontier payload of a periodic `MGarbageCollection` broadcast:
+    committed-and-executed contiguous prefix per coordinator."""
+    return jnp.minimum(gc.frontier[p], gc.exec_frontier[p])
+
+
+def gc_stable_row(gc: GCTrack, p) -> jnp.ndarray:
+    """Stable-watermark payload of the same broadcast (window floors)."""
+    return gc.stable_wm[p]
+
+
+def clear_window_mask(old_wm: jnp.ndarray, new_wm: jnp.ndarray, window: int) -> jnp.ndarray:
+    """[n*W] bool — ring slots whose occupant's sequence lies in
+    (old_wm, new_wm] per coordinator: the newly-stable state to clear."""
+    n = old_wm.shape[0]
+    j = jnp.arange(window, dtype=jnp.int32)[None, :]  # [1, W]
+    start = (old_wm % window)[:, None]  # seq old_wm+1 sits at slot old_wm % W
+    count = (new_wm - old_wm)[:, None]
+    return (((j - start) % window) < count).reshape(n * window)
+
+
+def gc_handle_mgc(
+    gc: GCTrack, p, src, frontier_in: jnp.ndarray, stable_in: jnp.ndarray,
+    window: int, pid=None, peers_mask=None,
+) -> Tuple[GCTrack, jnp.ndarray]:
+    """Join a peer's frontier clock, record its stable watermark, fold
+    newly-stable dots into the Stable metric (inlines the `MStable`
+    self-forward), and return the [DOTS] mask of newly-stable ring slots
+    for the caller to clear its per-dot state with.
 
     `pid` is the process's global identity (ctx.pid); `p` only indexes the
     state row (they differ under the distributed runner). `peers_mask` is a
     bitmask of the processes whose reports stability waits on (the GC
-    group — the process's shard under partial replication); defaults to
-    every process."""
+    group); defaults to every process."""
     n = gc.clock_of.shape[1]
     gc = gc._replace(
-        clock_of=gc.clock_of.at[p, src].set(jnp.maximum(gc.clock_of[p, src], incoming)),
+        clock_of=gc.clock_of.at[p, src].set(jnp.maximum(gc.clock_of[p, src], frontier_in)),
         heard_from=gc.heard_from.at[p, src].set(True),
+        stable_of=gc.stable_of.at[p, src].set(
+            jnp.maximum(gc.stable_of[p, src], stable_in)
+        ),
     )
     me = p if pid is None else pid
     others = jnp.arange(n) != me
     if peers_mask is not None:
         others = others & (((peers_mask >> jnp.arange(n)) & 1) == 1)
     all_heard = jnp.where(others, gc.heard_from[p], True).all()
-    peer_min = jnp.where(others[:, None], gc.clock_of[p], jnp.int32(2**30)).min(axis=0)
-    stable = jnp.minimum(gc.frontier[p], peer_min)
-    new_wm = jnp.maximum(gc.stable_wm[p], stable)  # never go backwards
-    gained = jnp.where(all_heard, (new_wm - gc.stable_wm[p]).sum(), 0)
-    return gc._replace(
-        stable_wm=gc.stable_wm.at[p].set(jnp.where(all_heard, new_wm, gc.stable_wm[p])),
+    peer_min = jnp.where(others[:, None], gc.clock_of[p], _INF).min(axis=0)
+    own = jnp.minimum(gc.frontier[p], gc.exec_frontier[p])
+    stable = jnp.minimum(own, peer_min)
+    old_wm = gc.stable_wm[p]
+    new_wm = jnp.where(
+        all_heard, jnp.maximum(old_wm, stable), old_wm
+    )  # never go backwards
+    gained = (new_wm - old_wm).sum()
+    cleared = clear_window_mask(old_wm, new_wm, window)
+    gc = gc._replace(
+        stable_wm=gc.stable_wm.at[p].set(new_wm),
         stable_count=gc.stable_count.at[p].add(gained),
     )
+    return gc, cleared
 
 
-def gc_frontier_row(gc: GCTrack, p) -> jnp.ndarray:
-    """The payload of a periodic `MGarbageCollection` broadcast."""
-    return gc.frontier[p]
+def gc_live(gc: GCTrack, p, dot) -> jnp.ndarray:
+    """False for stragglers referencing a dead (stable, possibly recycled)
+    generation — handlers drop these, like the reference finding no entry in
+    its per-dot registry after `cmds.gc` removed it."""
+    a = ids.dot_proc(dot)
+    n = gc.stable_wm.shape[1]
+    wm = jnp.sum(
+        jnp.where(jnp.arange(n) == a, gc.stable_wm[p], 0)
+    )
+    return ids.dot_seq(dot) > wm
+
+
+def gc_floor(gc: GCTrack) -> jnp.ndarray:
+    """[n] — for each coordinator p, the highest of p's sequences that every
+    process has REPORTED stable to p (the engine's slot-reuse gate)."""
+    n = gc.stable_wm.shape[0]
+    pidx = jnp.arange(n)
+    # stable_of[p, q, p] per q; a process's own watermark stands in for its
+    # (never-sent) self-report
+    own = gc.stable_wm[pidx, pidx]  # [n]
+    reported = gc.stable_of[pidx, :, pidx]  # [n(p), n(q)]
+    reported = jnp.where(pidx[None, :] == pidx[:, None], own[:, None], reported)
+    return reported.min(axis=1)

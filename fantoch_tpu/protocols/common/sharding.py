@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ...core.ids import dot_proc
+from ...core import ids
 
 
 def key_shard(key, shards: int):
@@ -25,12 +25,13 @@ def slot_mask(ctx, dot, shards: int):
     kpc = ctx.cmds.keys.shape[1]
     if shards == 1:
         return jnp.ones((kpc,), jnp.bool_)
-    return key_shard(ctx.cmds.keys[dot], shards) == ctx.env.shard_of[ctx.pid]
+    sl = ids.dot_slot(dot, ctx.spec.max_seq)
+    return key_shard(ctx.cmds.keys[sl], shards) == ctx.env.shard_of[ctx.pid]
 
 
 def shard_touch(ctx, dot, shards: int):
     """[shards] bool: shards the command has a key in."""
-    ks = key_shard(ctx.cmds.keys[dot], shards)
+    ks = key_shard(ctx.cmds.keys[ids.dot_slot(dot, ctx.spec.max_seq)], shards)
     return jnp.stack([(ks == t).any() for t in range(shards)])
 
 
@@ -43,5 +44,5 @@ def own_coord(ctx, dot, shards: int):
     stay contiguous, while remote-coordinator dots would leave holes."""
     if shards == 1:
         return jnp.bool_(True)
-    coord = dot_proc(dot, ctx.spec.max_seq)
+    coord = ids.dot_proc(dot)
     return ctx.env.shard_of[coord] == ctx.env.shard_of[ctx.pid]

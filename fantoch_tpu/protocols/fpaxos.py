@@ -39,6 +39,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..core import ids
 from ..engine.types import (
     ExecOut,
     ProtocolDef,
@@ -124,7 +125,9 @@ def make_protocol(
             # the leader records command size when spawning the commander
             # (fpaxos.rs:168-174)
             key_count_hist=hist_add(
-                st.key_count_hist, p, distinct_count(ctx.cmds.keys[dot]), enable
+                st.key_count_hist, p,
+                distinct_count(ctx.cmds.keys[ids.dot_slot(dot, ctx.spec.max_seq)]),
+                enable,
             ),
             last_slot=st.last_slot.at[p].add(enable.astype(jnp.int32)),
             cmdr_alive=st.cmdr_alive.at[p, idx].set(

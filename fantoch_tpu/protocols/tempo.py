@@ -41,7 +41,7 @@ Message kinds/payloads (int32 rows):
 - MDETACHED     [key, start, end]                      (voter = src)
 - MCONSENSUS    [dot, ballot, clock]
 - MCONSENSUSACK [dot, ballot]
-- MGC           [frontier_0 .. frontier_{n-1}]
+- MGC           [frontier_0..n-1, stable_0..n-1]
 """
 from __future__ import annotations
 
@@ -51,7 +51,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from ..core.ids import dot_proc
+from ..core import ids
 from ..engine.types import (
     ExecOut,
     ProtocolDef,
@@ -108,6 +108,9 @@ class TempoState(NamedTuple):
     sc_cnt: jnp.ndarray  # [n, DOTS] int32 shard clocks received
     sc_max: jnp.ndarray  # [n, DOTS] int32 max shard clock
     max_commit_clock: jnp.ndarray  # [n] int32
+    shipped: jnp.ndarray  # [n, K] int32 detached-vote watermark per key
+    # (buffer_detached builds; [n, 1] dummy otherwise)
+    detached_sent: jnp.ndarray  # [n] int32 MDETACHED rows broadcast
     gc: gc_mod.GCTrack
     fast_count: jnp.ndarray  # [n] int32
     slow_count: jnp.ndarray  # [n] int32
@@ -124,11 +127,23 @@ def make_protocol(
     clock_bump: bool = False,
     shards: int = 1,
     skip_fast_ack: bool = False,
+    buffer_detached: bool = False,
 ) -> ProtocolDef:
     """Build the Tempo ProtocolDef.
 
-    `key_space_hint` is only needed when `clock_bump` is set (the ClockBump
-    periodic event iterates all keys, so its outbox is K rows wide).
+    `key_space_hint` is only needed when `clock_bump` or `buffer_detached`
+    is set (their periodic events iterate all keys, so their outboxes are K
+    rows wide).
+
+    `buffer_detached` is the reference's `SendDetached` periodic
+    (`tempo.rs:1013-1026` + `Config::tempo_detached_send_interval`): instead
+    of broadcasting every detached vote range eagerly, votes stay implicit
+    (each key's clock runs ahead of a per-key *shipped* watermark) and a
+    periodic event ships one covering `MDETACHED` range per pending key.
+    Vote ranges are frontier-joined by the table executor, so a covering
+    range that also spans already-shipped attached votes is a no-op there —
+    the buffered-`Votes` compression of the reference without its unbounded
+    host-side map.
     With `shards` > 1, `n` is the TOTAL process count and multi-shard
     commands follow the reference's partial-replication flow
     (`protocol/partial.rs` + the tempo.rs MShardCommit handlers): the
@@ -150,10 +165,10 @@ def make_protocol(
     assert not (skip_fast_ack and shards > 1), (
         "skip_fast_ack is a single-shard optimization (tempo.rs:317)"
     )
-    MSG_W = max(2 + 2 * KPC * n, n, 3 + 2 * KPC)
+    MSG_W = max(2 + 2 * KPC * n, 2 * n, 3 + 2 * KPC)
     MAX_OUT = max(2 + KPC + (1 if shards > 1 else 0), 1 + shards)
     MAX_EXEC = KPC
-    exdef = table_executor.make_executor(n)
+    exdef = table_executor.make_executor(n, shards)
     EW = exdef.exec_width
 
     def init(spec, env):
@@ -178,6 +193,8 @@ def make_protocol(
             sc_cnt=z(n, DOTS),
             sc_max=z(n, DOTS),
             max_commit_clock=z(n),
+            shipped=z(n, K if buffer_detached else 1),
+            detached_sent=z(n),
             gc=gc_mod.gc_init(n, DOTS),
             fast_count=z(n),
             slow_count=z(n),
@@ -217,38 +234,47 @@ def make_protocol(
         """KeyClocks::proposal — clock = max(min_clock, cur+1) (no bump for
         NFR-allowed reads), votes = the bumped ranges per key. Only the
         handling process's own shard's key slots participate."""
-        keys = ctx.cmds.keys[dot]
+        keys = ctx.cmds.keys[ids.dot_slot(dot, ctx.spec.max_seq)]
         mask = _slot_mask(ctx, dot)
         cur = jnp.int32(0)
         for i in range(KPC):
             cur = jnp.maximum(cur, jnp.where(mask[i], st.clocks[p, keys[i]], 0))
         bump = jnp.int32(1)
         if nfr and KPC == 1:
-            bump = jnp.where(ctx.cmds.read_only[dot], 0, 1)
+            bump = jnp.where(
+                ctx.cmds.read_only[ids.dot_slot(dot, ctx.spec.max_seq)], 0, 1
+            )
         clock = jnp.maximum(min_clock, cur + bump)
         st, ss, es = _vote_up_to(st, p, keys, clock, enable, slot_en=mask)
         return st, clock, ss, es
 
     def _detached_rows(ctx, st: TempoState, ob, row0, p, dot, up_to, enable):
         """Generate detached votes on the dot's keys up to `up_to` and emit
-        them eagerly as MDETACHED broadcast rows (see module docstring)."""
-        keys = ctx.cmds.keys[dot]
+        them eagerly as MDETACHED broadcast rows — or, with
+        `buffer_detached`, just advance the clocks: the votes stay pending
+        until the SendDetached periodic ships a covering range per key."""
+        keys = ctx.cmds.keys[ids.dot_slot(dot, ctx.spec.max_seq)]
         st, ss, es = _vote_up_to(st, p, keys, up_to, enable,
                                  slot_en=_slot_mask(ctx, dot))
+        if buffer_detached:
+            return st, ob
         for i in range(KPC):
             ob = outbox_row(
                 ob, row0 + i, ss[i] > 0, ctx.env.all_mask[p], MDETACHED,
                 [keys[i], ss[i], es[i]],
             )
+        st = st._replace(
+            detached_sent=st.detached_sent.at[p].add((ss > 0).sum())
+        )
         return st, ob
 
-    def _mcommit_payload(votes_s, votes_e, p, dot, clock):
+    def _mcommit_payload(votes_s, votes_e, p, dot, sl, clock):
         """MCommit wire layout: [dot, clock, (start,end) x KPC x n] —
         decoded by h_mcommit's stride-2 slices."""
         payload = [dot, clock]
         for k in range(KPC):
             for v in range(n):
-                payload += [votes_s[p, dot, k, v], votes_e[p, dot, k, v]]
+                payload += [votes_s[p, sl, k, v], votes_e[p, sl, k, v]]
         return payload
 
     # ------------------------------------------------------------------
@@ -258,16 +284,17 @@ def make_protocol(
     def _commit(ctx, st: TempoState, ob, row0, p, dot, clock, rs, re, enable):
         """Shared commit path: mark COMMIT, emit attached-vote execution
         infos, bump `max_commit_clock`, generate detached votes, track GC."""
+        sl = ids.dot_slot(dot, ctx.spec.max_seq)
         st = st._replace(
-            status=st.status.at[p, dot].set(
-                jnp.where(enable, COMMIT, st.status[p, dot])
+            status=st.status.at[p, sl].set(
+                jnp.where(enable, COMMIT, st.status[p, sl])
             ),
             max_commit_clock=st.max_commit_clock.at[p].max(
                 jnp.where(enable, clock, 0)
             ),
             synod=st.synod._replace(
-                acc_val=st.synod.acc_val.at[p, dot].set(
-                    jnp.where(enable, clock, st.synod.acc_val[p, dot])
+                acc_val=st.synod.acc_val.at[p, sl].set(
+                    jnp.where(enable, clock, st.synod.acc_val[p, sl])
                 )
             ),
             commit_count=st.commit_count.at[p].add(enable.astype(jnp.int32)),
@@ -298,17 +325,18 @@ def make_protocol(
         """Single-shard commands broadcast `MCommit` in-shard; multi-shard
         commands send `MShardCommit{dot, clock}` to the dot's coordinator
         for aggregation (partial.rs mcommit_actions)."""
+        sl = ids.dot_slot(dot, ctx.spec.max_seq)
         if shards == 1:
-            pay = _mcommit_payload(st.votes_s, st.votes_e, p, dot, clock)
+            pay = _mcommit_payload(st.votes_s, st.votes_e, p, dot, sl, clock)
             ob = outbox_row(ob, rowA, enable, ctx.env.all_mask[p], MCOMMIT, pay)
             return st, ob
         nsh = _shard_touch(ctx, dot).sum()
         single = nsh <= 1
-        pay = _mcommit_payload(st.votes_s, st.votes_e, p, dot, clock)
+        pay = _mcommit_payload(st.votes_s, st.votes_e, p, dot, sl, clock)
         ob = outbox_row(
             ob, rowA, enable & single, ctx.env.all_mask[p], MCOMMIT, pay
         )
-        agg = dot_proc(dot, ctx.spec.max_seq)
+        agg = ids.dot_proc(dot)
         ob = outbox_row(
             ob, rowB, enable & ~single, jnp.int32(1) << agg, MSHARDC,
             [dot, clock],
@@ -320,22 +348,23 @@ def make_protocol(
     # ------------------------------------------------------------------
 
     def submit(ctx, st: TempoState, p, dot, now):
+        sl = ids.dot_slot(dot, ctx.spec.max_seq)
         st = st._replace(
             key_count_hist=hist_add(
-                st.key_count_hist, p, distinct_count(ctx.cmds.keys[dot]), True
+                st.key_count_hist, p, distinct_count(ctx.cmds.keys[sl]), True
             )
         )
         st, clock, ss, es = _proposal(ctx, st, p, dot, jnp.int32(0), jnp.bool_(True))
         # store coordinator votes for later aggregation (tempo.rs:297-310)
         st = st._replace(
-            votes_s=st.votes_s.at[p, dot, :, ctx.pid].set(ss),
-            votes_e=st.votes_e.at[p, dot, :, ctx.pid].set(es),
+            votes_s=st.votes_s.at[p, sl, :, ctx.pid].set(ss),
+            votes_e=st.votes_e.at[p, sl, :, ctx.pid].set(es),
         )
         # NFR single-key reads use a plain majority as the fast quorum
         # (BaseProcess::maybe_adjust_fast_quorum)
         if nfr and KPC == 1:
             qmask = jnp.where(
-                ctx.cmds.read_only[dot], ctx.env.maj_mask[p], ctx.env.fq_mask[p]
+                ctx.cmds.read_only[sl], ctx.env.maj_mask[p], ctx.env.fq_mask[p]
             )
         else:
             qmask = ctx.env.fq_mask[p]
@@ -365,15 +394,16 @@ def make_protocol(
         shard-local proposal and start this shard's collect round
         (handle_submit re-runs here, so CommandKeyCount records again)."""
         dot = payload[0]
+        sl = ids.dot_slot(dot, ctx.spec.max_seq)
         st = st._replace(
             key_count_hist=hist_add(
-                st.key_count_hist, p, distinct_count(ctx.cmds.keys[dot]), True
+                st.key_count_hist, p, distinct_count(ctx.cmds.keys[sl]), True
             )
         )
         st, clock, ss, es = _proposal(ctx, st, p, dot, jnp.int32(0), jnp.bool_(True))
         st = st._replace(
-            votes_s=st.votes_s.at[p, dot, :, ctx.pid].set(ss),
-            votes_e=st.votes_e.at[p, dot, :, ctx.pid].set(es),
+            votes_s=st.votes_s.at[p, sl, :, ctx.pid].set(ss),
+            votes_e=st.votes_e.at[p, sl, :, ctx.pid].set(es),
         )
         ob = outbox_row(
             empty_outbox(MAX_OUT, MSG_W), 0,
@@ -388,11 +418,12 @@ def make_protocol(
         clock back to each shard's coordinator (partial.rs
         handle_mshard_commit)."""
         dot, clock = payload[0], payload[1]
-        cnt = st.sc_cnt[p, dot] + 1
-        mx = jnp.maximum(st.sc_max[p, dot], clock)
+        sl = ids.dot_slot(dot, ctx.spec.max_seq)
+        cnt = st.sc_cnt[p, sl] + 1
+        mx = jnp.maximum(st.sc_max[p, sl], clock)
         st = st._replace(
-            sc_cnt=st.sc_cnt.at[p, dot].set(cnt),
-            sc_max=st.sc_max.at[p, dot].set(mx),
+            sc_cnt=st.sc_cnt.at[p, sl].set(cnt),
+            sc_max=st.sc_max.at[p, sl].set(mx),
         )
         touch = _shard_touch(ctx, dot)
         done = cnt == touch.sum()
@@ -412,7 +443,10 @@ def make_protocol(
         final MCommit in this shard with the aggregated clock and this
         shard's votes (partial.rs handle_mshard_aggregated_commit)."""
         dot, clock = payload[0], payload[1]
-        pay = _mcommit_payload(st.votes_s, st.votes_e, p, dot, clock)
+        pay = _mcommit_payload(
+            st.votes_s, st.votes_e, p, dot,
+            ids.dot_slot(dot, ctx.spec.max_seq), clock,
+        )
         ob = outbox_row(
             empty_outbox(MAX_OUT, MSG_W), 0,
             jnp.bool_(True), ctx.env.all_mask[p], MCOMMIT, pay,
@@ -421,7 +455,9 @@ def make_protocol(
 
     def h_mcollect(ctx, st: TempoState, p, src, payload, now):
         dot, rclock, qmask = payload[0], payload[1], payload[2]
-        is_start = st.status[p, dot] == START
+        sl = ids.dot_slot(dot, ctx.spec.max_seq)
+        live = gc_mod.gc_live(st.gc, p, dot)
+        is_start = live & (st.status[p, sl] == START)
         in_q = bit(qmask, ctx.pid) == 1
         from_self = src == ctx.pid
 
@@ -436,16 +472,16 @@ def make_protocol(
         for i in range(n):
             qsz = qsz + bit(qmask, jnp.int32(i))
         st = st._replace(
-            status=st.status.at[p, dot].set(
+            status=st.status.at[p, sl].set(
                 jnp.where(
                     is_start,
                     jnp.where(in_q, COLLECT, PAYLOAD),
-                    st.status[p, dot],
+                    st.status[p, sl],
                 )
             ),
-            qmask=st.qmask.at[p, dot].set(jnp.where(q_en, qmask, st.qmask[p, dot])),
-            qsize=st.qsize.at[p, dot].set(jnp.where(q_en, qsz, st.qsize[p, dot])),
-            synod=synod_mod.set_if_not_accepted(st.synod, p, dot, clk, q_en),
+            qmask=st.qmask.at[p, sl].set(jnp.where(q_en, qmask, st.qmask[p, sl])),
+            qsize=st.qsize.at[p, sl].set(jnp.where(q_en, qsz, st.qsize[p, sl])),
+            synod=synod_mod.set_if_not_accepted(st.synod, p, sl, clk, q_en),
         )
         ack_payload = [dot, clk]
         for i in range(KPC):
@@ -484,43 +520,45 @@ def make_protocol(
             )
         # non-quorum member: payload only; flush a buffered commit if the
         # MCommit overtook the MCollect (tempo.rs:369-387)
-        flush = is_start & ~in_q & st.bufc_valid[p, dot]
-        st = st._replace(bufc_valid=st.bufc_valid.at[p, dot].set(
-            st.bufc_valid[p, dot] & ~flush
+        flush = is_start & ~in_q & st.bufc_valid[p, sl]
+        st = st._replace(bufc_valid=st.bufc_valid.at[p, sl].set(
+            st.bufc_valid[p, sl] & ~flush
         ))
         st, ob, execout = _commit(
             ctx, st, ob, 1, p, dot,
-            st.bufc_clock[p, dot], st.bufc_s[p, dot], st.bufc_e[p, dot], flush,
+            st.bufc_clock[p, sl], st.bufc_s[p, sl], st.bufc_e[p, sl], flush,
         )
         return st, ob, execout
 
     def h_mcollectack(ctx, st: TempoState, p, src, payload, now):
         dot, clk = payload[0], payload[1]
-        collect = st.status[p, dot] == COLLECT
+        sl = ids.dot_slot(dot, ctx.spec.max_seq)
+        live = gc_mod.gc_live(st.gc, p, dot)
+        collect = live & (st.status[p, sl] == COLLECT)
 
         # merge remote votes (tempo.rs:493-495)
         votes_s, votes_e = st.votes_s, st.votes_e
         for i in range(KPC):
             s_i, e_i = payload[2 + 2 * i], payload[3 + 2 * i]
             take = collect & (s_i > 0)
-            votes_s = votes_s.at[p, dot, i, src].set(
-                jnp.where(take, s_i, votes_s[p, dot, i, src])
+            votes_s = votes_s.at[p, sl, i, src].set(
+                jnp.where(take, s_i, votes_s[p, sl, i, src])
             )
-            votes_e = votes_e.at[p, dot, i, src].set(
-                jnp.where(take, e_i, votes_e[p, dot, i, src])
+            votes_e = votes_e.at[p, sl, i, src].set(
+                jnp.where(take, e_i, votes_e[p, sl, i, src])
             )
 
         # QuorumClocks::add (quorum.rs:36-60)
-        old_max, old_cnt = st.qc_max[p, dot], st.qc_maxcount[p, dot]
+        old_max, old_cnt = st.qc_max[p, sl], st.qc_maxcount[p, sl]
         new_max = jnp.maximum(old_max, clk)
         new_cnt = jnp.where(clk > old_max, 1, jnp.where(clk == old_max, old_cnt + 1, old_cnt))
-        count = st.qc_count[p, dot] + collect.astype(jnp.int32)
+        count = st.qc_count[p, sl] + collect.astype(jnp.int32)
         st = st._replace(
             votes_s=votes_s,
             votes_e=votes_e,
-            qc_count=st.qc_count.at[p, dot].set(count),
-            qc_max=st.qc_max.at[p, dot].set(jnp.where(collect, new_max, old_max)),
-            qc_maxcount=st.qc_maxcount.at[p, dot].set(
+            qc_count=st.qc_count.at[p, sl].set(count),
+            qc_max=st.qc_max.at[p, sl].set(jnp.where(collect, new_max, old_max)),
+            qc_maxcount=st.qc_maxcount.at[p, sl].set(
                 jnp.where(collect, new_cnt, old_cnt)
             ),
         )
@@ -532,21 +570,21 @@ def make_protocol(
         )
 
         # all fast-quorum clocks in? (tempo.rs:524-570)
-        all_in = collect & (count == st.qsize[p, dot])
+        all_in = collect & (count == st.qsize[p, sl])
         minority = ranks // 2  # a minority of this shard's replicas
-        threshold = st.qsize[p, dot] - minority
+        threshold = st.qsize[p, sl] - minority
         fast = all_in & (new_cnt >= threshold)
         slow = all_in & ~(new_cnt >= threshold)
 
         # slow path: synod with skipped prepare (ballot = 1-based own id)
         st = st._replace(
             synod=synod_mod.skip_prepare(
-                st.synod, p, dot, new_max, slow, pid=ctx.pid
+                st.synod, p, sl, new_max, slow, pid=ctx.pid
             ),
             fast_count=st.fast_count.at[p].add(fast.astype(jnp.int32)),
             slow_count=st.slow_count.at[p].add(slow.astype(jnp.int32)),
             slow_read_count=st.slow_read_count.at[p].add(
-                (slow & ctx.cmds.read_only[dot]).astype(jnp.int32)
+                (slow & ctx.cmds.read_only[sl]).astype(jnp.int32)
             ),
         )
         ob = outbox_row(
@@ -561,24 +599,28 @@ def make_protocol(
 
     def h_mcommit(ctx, st: TempoState, p, src, payload, now):
         dot, clock = payload[0], payload[1]
+        sl = ids.dot_slot(dot, ctx.spec.max_seq)
+        live = gc_mod.gc_live(st.gc, p, dot)
         rs = payload[2 : 2 + 2 * KPC * n : 2].reshape(KPC, n)
         re = payload[3 : 3 + 2 * KPC * n : 2].reshape(KPC, n)
-        is_start = st.status[p, dot] == START
-        can_commit = (st.status[p, dot] == PAYLOAD) | (st.status[p, dot] == COLLECT)
+        is_start = live & (st.status[p, sl] == START)
+        can_commit = live & (
+            (st.status[p, sl] == PAYLOAD) | (st.status[p, sl] == COLLECT)
+        )
 
         # MCommit before MCollect: buffer it (tempo.rs:594-599)
         st = st._replace(
-            bufc_valid=st.bufc_valid.at[p, dot].set(
-                st.bufc_valid[p, dot] | is_start
+            bufc_valid=st.bufc_valid.at[p, sl].set(
+                st.bufc_valid[p, sl] | is_start
             ),
-            bufc_clock=st.bufc_clock.at[p, dot].set(
-                jnp.where(is_start, clock, st.bufc_clock[p, dot])
+            bufc_clock=st.bufc_clock.at[p, sl].set(
+                jnp.where(is_start, clock, st.bufc_clock[p, sl])
             ),
-            bufc_s=st.bufc_s.at[p, dot].set(
-                jnp.where(is_start, rs, st.bufc_s[p, dot])
+            bufc_s=st.bufc_s.at[p, sl].set(
+                jnp.where(is_start, rs, st.bufc_s[p, sl])
             ),
-            bufc_e=st.bufc_e.at[p, dot].set(
-                jnp.where(is_start, re, st.bufc_e[p, dot])
+            bufc_e=st.bufc_e.at[p, sl].set(
+                jnp.where(is_start, re, st.bufc_e[p, sl])
             ),
         )
         ob = empty_outbox(MAX_OUT, MSG_W)
@@ -599,24 +641,27 @@ def make_protocol(
 
     def h_mconsensus(ctx, st: TempoState, p, src, payload, now):
         dot, ballot, clock = payload[0], payload[1], payload[2]
-        chosen = st.status[p, dot] == COMMIT
+        sl = ids.dot_slot(dot, ctx.spec.max_seq)
+        live = gc_mod.gc_live(st.gc, p, dot)
+        chosen = live & (st.status[p, sl] == COMMIT)
         ob = empty_outbox(MAX_OUT, MSG_W)
         # detached votes up to the consensus clock if we have the payload
         # (tempo.rs:756-761)
         st, ob = _detached_rows(
             ctx, st, ob, 1, p, dot, clock,
-            ~chosen & (st.status[p, dot] != START),
+            live & ~chosen & (st.status[p, sl] != START),
         )
-        sy, accepted = synod_mod.handle_accept(st.synod, p, dot, ballot, clock)
+        sy, accepted = synod_mod.handle_accept(st.synod, p, sl, ballot, clock)
+        accepted = accepted & live
         st = st._replace(
             synod=jax.tree_util.tree_map(
-                lambda a, b: jnp.where(chosen, a, b), st.synod, sy
+                lambda a, b: jnp.where(chosen | ~live, a, b), st.synod, sy
             )
         )
         # already chosen: reply MCommit with the stored votes (tempo.rs:780-786);
         # otherwise ack the accept
         commit_payload = _mcommit_payload(
-            st.votes_s, st.votes_e, p, dot, st.synod.acc_val[p, dot]
+            st.votes_s, st.votes_e, p, dot, sl, st.synod.acc_val[p, sl]
         )
         ack_payload = [dot, ballot] + [jnp.int32(0)] * (len(commit_payload) - 2)
         pay = jnp.where(
@@ -635,25 +680,59 @@ def make_protocol(
 
     def h_mconsensusack(ctx, st: TempoState, p, src, payload, now):
         dot, ballot = payload[0], payload[1]
-        not_committed = st.status[p, dot] != COMMIT
+        sl = ids.dot_slot(dot, ctx.spec.max_seq)
+        live = gc_mod.gc_live(st.gc, p, dot)
+        not_committed = live & (st.status[p, sl] != COMMIT)
         sy, chosen, value = synod_mod.handle_accepted(
-            st.synod, p, dot, ballot, ctx.env.wq_size, src
+            st.synod, p, sl, ballot, ctx.env.wq_size, src
         )
         chosen = chosen & not_committed
-        st = st._replace(synod=sy)
+        st = st._replace(
+            synod=jax.tree_util.tree_map(
+                lambda a, b: jnp.where(live, a, b), sy, st.synod
+            )
+        )
         st, ob = _commit_or_aggregate(
             ctx, st, empty_outbox(MAX_OUT, MSG_W), 0, 1, p, dot, value, chosen
         )
         return st, ob, empty_execout(MAX_EXEC, EW)
 
     def h_mgc(ctx, st: TempoState, p, src, payload, now):
-        st = st._replace(
-            gc=gc_mod.gc_handle_mgc(
-                st.gc, p, src, payload[:n], pid=ctx.pid,
-                peers_mask=ctx.env.all_mask[p],
-            )
+        gc, cleared = gc_mod.gc_handle_mgc(
+            st.gc, p, src, payload[:n], payload[n:2 * n],
+            ctx.spec.max_seq, pid=ctx.pid,
+            peers_mask=ctx.env.all_mask[p],
         )
+        st = _clear_slots(st._replace(gc=gc), p, cleared)
         return st, empty_outbox(MAX_OUT, MSG_W), empty_execout(MAX_EXEC, EW)
+
+    def _clear_slots(st: TempoState, p, cleared):
+        """Recycle newly-stable ring slots: zero every per-dot leaf of row
+        `p` (the reference deletes stable dots from its registries)."""
+        rows = st.status.shape[0]
+        rowm = jnp.arange(rows)[:, None] == p
+        cm = rowm & cleared[None, :]
+        z2 = lambda x: jnp.where(cm, 0, x) if x.dtype != jnp.bool_ else x & ~cm
+        z4 = lambda x: jnp.where(cm[:, :, None, None], 0, x)
+        sy = st.synod
+        sy = type(sy)(*(z2(leaf) for leaf in sy))
+        return st._replace(
+            status=z2(st.status),
+            qmask=z2(st.qmask),
+            qsize=z2(st.qsize),
+            qc_count=z2(st.qc_count),
+            qc_max=z2(st.qc_max),
+            qc_maxcount=z2(st.qc_maxcount),
+            votes_s=z4(st.votes_s),
+            votes_e=z4(st.votes_e),
+            bufc_valid=z2(st.bufc_valid),
+            bufc_clock=z2(st.bufc_clock),
+            bufc_s=z4(st.bufc_s),
+            bufc_e=z4(st.bufc_e),
+            synod=sy,
+            sc_cnt=z2(st.sc_cnt),
+            sc_max=z2(st.sc_max),
+        )
 
     def handle(ctx, st, p, src, kind, payload, now):
         branches = [
@@ -681,12 +760,36 @@ def make_protocol(
         if kind == 0:
             # GarbageCollection (tempo.rs:973-988)
             all_but_me = ctx.env.all_mask[p] & ~(jnp.int32(1) << ctx.pid)
-            row = gc_mod.gc_frontier_row(st.gc, p)
+            row = gc_mod.gc_report_row(st.gc, p)
+            wm = gc_mod.gc_stable_row(st.gc, p)
             ob = outbox_row(
                 empty_outbox(1, MSG_W), 0,
-                jnp.bool_(True), all_but_me, MGC, [row[a] for a in range(n)],
+                jnp.bool_(True), all_but_me, MGC,
+                [row[a] for a in range(n)] + [wm[a] for a in range(n)],
             )
             return st, ob
+        if kind == 2:
+            # SendDetached (tempo.rs:1013-1026): ship one covering MDETACHED
+            # range per key whose clock ran ahead of the shipped watermark
+            K = key_space_hint
+            assert K > 0, "buffer_detached needs key_space_hint"
+            ob = empty_outbox(K, MSG_W)
+            shipped = st.shipped
+            for k in range(K):
+                clk = st.clocks[p, k]
+                wm = shipped[p, k]
+                pending = clk > wm
+                ob = outbox_row(
+                    ob, k, pending, ctx.env.all_mask[p], MDETACHED,
+                    [jnp.int32(k), wm + 1, clk],
+                )
+                shipped = shipped.at[p, k].set(jnp.where(pending, clk, wm))
+                st = st._replace(
+                    detached_sent=st.detached_sent.at[p].add(
+                        pending.astype(jnp.int32)
+                    )
+                )
+            return st._replace(shipped=shipped), ob
         # ClockBump (tempo.rs:991-1010): bump every key to
         # max(max_commit_clock, now in micros), emitting detached votes
         K = key_space_hint
@@ -718,14 +821,27 @@ def make_protocol(
             "fast": st.fast_count,
             "slow_reads": st.slow_read_count,
             "slow": st.slow_count,
+            "detached_sent": st.detached_sent,
             "command_key_count_hist": st.key_count_hist,
         }
 
-    periodic_events = [("garbage_collection", lambda cfg: cfg.gc_interval_ms)]
-    if clock_bump:
-        periodic_events.append(
-            ("clock_bump", lambda cfg: cfg.tempo_clock_bump_interval_ms)
-        )
+    # fixed event indices (the engine passes the index into this list as
+    # the periodic `kind`): 0 = gc, 1 = clock bump, 2 = send detached
+    periodic_events = [
+        ("garbage_collection", lambda cfg: cfg.gc_interval_ms),
+        ("clock_bump",
+         (lambda cfg: cfg.tempo_clock_bump_interval_ms)
+         if clock_bump else (lambda cfg: None)),
+        ("send_detached",
+         (lambda cfg: cfg.tempo_detached_send_interval_ms)
+         if buffer_detached else (lambda cfg: None)),
+    ]
+
+    def handle_executed(ctx, st: TempoState, p, info, now):
+        """Fold the table executor's fully-executed frontier into GC
+        (window compaction)."""
+        st = st._replace(gc=gc_mod.gc_note_exec(st.gc, p, info[:n]))
+        return st, empty_outbox(1, MSG_W)
 
     return ProtocolDef(
         name="tempo",
@@ -740,6 +856,10 @@ def make_protocol(
         handle=handle,
         periodic_events=tuple(periodic_events),
         periodic=periodic,
+        handle_executed=handle_executed,
+        window_floor=(
+            (lambda pstate: gc_mod.gc_floor(pstate.gc)) if shards == 1 else None
+        ),
         quorum_sizes=lambda cfg: cfg.tempo_quorum_sizes(),
         leaderless=True,
         metrics=metrics,

@@ -52,7 +52,7 @@ def test_execution_log_replay_roundtrip():
 
     total = 2 * 10
     for p in range(3):
-        rows = extract_graph_log(st, p)
+        rows = extract_graph_log(st, p, spec.max_seq)
         assert len(rows) == total  # single shard: one commit record per dot
         out = replay_graph_stream(rows)
         assert out["executed_count"] == total

@@ -223,7 +223,7 @@ def test_atlas_two_shards_spanning_commands():
     check_shard_stable(st, spec)
     # spanning commands create cross-shard dependencies: the executors must
     # have fetched remote vertices to order through them
-    assert int(np.asarray(st.exec.requested).sum()) > 0
+    assert int(np.asarray(st.exec.out_requests).sum()) > 0
 
 
 def test_epaxos_two_shards_spanning_commands():

@@ -9,10 +9,21 @@ harness (shape-bucketed, chunked device calls), renders the headline
 figures, and prints one JSON line with configs-swept/hour.
 
     python tools/northstar.py --out northstar_results [--scale 2]
+    python tools/northstar.py --out ns_milestones --milestone all
 
 Scale 1 is sized for a quick single-chip demonstration (~200 configs in a
 few minutes); raise --scale (or run on more chips with --mesh) for the full
 10k-config target.
+
+`--milestone` runs the BASELINE.json milestone configurations at their real
+shapes (not a scaled-down demo):
+
+1. fpaxos-baseline : FPaxos n=3 f=1, 0% conflict, latency_gcp
+2. epaxos-conflict : EPaxos n=5 f=2, conflict sweep {0,2,10,50,100}%
+3. atlas-vs-janus  : Atlas vs Janus n=5, AWS 2021_02_13 placements
+4. tempo-hot       : Tempo n=7 f=3, 100% conflict
+5. joint-10k       : Caesar + EPaxos joint sweep over n in {3,5,7,9} x f x
+                     conflict x GCP placements x seeds (~10k configs)
 """
 import argparse
 import json
@@ -40,6 +51,12 @@ def main(argv=None) -> int:
     ap.add_argument("--chunk-steps", type=int, default=1500)
     ap.add_argument("--mesh", action="store_true",
                     help="shard the batch over all devices")
+    ap.add_argument("--milestone", default=None,
+                    help="run BASELINE.json milestone configs: one of"
+                         " fpaxos-baseline, epaxos-conflict, atlas-vs-janus,"
+                         " tempo-hot, joint-10k, or 'all'")
+    ap.add_argument("--joint-scale", type=float, default=1.0,
+                    help="seed-axis multiplier for the joint-10k milestone")
     args = ap.parse_args(argv)
 
     import jax
@@ -53,6 +70,9 @@ def main(argv=None) -> int:
     from fantoch_tpu.exp.harness import Point, run_grid
     from fantoch_tpu.plot.db import ResultsDB
     from fantoch_tpu.plot import plots
+
+    if args.milestone:
+        return run_milestones(args)
 
     protocols = ["tempo", "atlas", "epaxos"]
     conflicts = [0, 2, 10, 50, 100]
@@ -131,6 +151,114 @@ def main(argv=None) -> int:
             }
         )
     )
+    return 0
+
+
+GCP20 = None  # filled lazily: all regions of the GCP latency dataset
+
+
+def _milestone_grids(args):
+    """The five BASELINE.json milestone configurations at real shapes."""
+    from fantoch_tpu.core.planet import Planet
+    from fantoch_tpu.exp.harness import Point
+
+    gcp = Planet.new()
+    gcp_regions = list(gcp.regions())
+    aws = Planet.from_dataset("aws_2021_02_13")
+    aws_regions = list(aws.regions())
+
+    def pts(proto, n, f, conflicts, seeds, clients=(2,), cmds=20, **kw):
+        return [
+            Point(protocol=proto, n=n, f=f, clients_per_region=c,
+                  conflict_rate=cf, pool_size=1, commands_per_client=cmds,
+                  seed=s, **kw)
+            for cf in conflicts for c in clients for s in range(seeds)
+        ]
+
+    grids = {
+        # 1. CPU-sim parity baseline shape (simulation.rs:140-216)
+        "fpaxos-baseline": [
+            (gcp, gcp_regions[:3], pts("fpaxos", 3, 1, [0], 8,
+                                       clients=(1, 2, 4)))
+        ],
+        # 2. batched conflict axis at n=5 f=2
+        "epaxos-conflict": [
+            (gcp, gcp_regions[:5], pts("epaxos", 5, 2, [0, 2, 10, 50, 100],
+                                       8, clients=(2, 4)))
+        ],
+        # 3. Atlas vs Janus over AWS region sets
+        "atlas-vs-janus": [
+            (aws, aws_regions[:5],
+             pts("atlas", 5, 1, [2, 50], 4) + pts("janus", 5, 1, [2, 50], 4)),
+            (aws, list(reversed(aws_regions))[:5],
+             pts("atlas", 5, 2, [2, 50], 4) + pts("janus", 5, 2, [2, 50], 4)),
+        ],
+        # 4. 100%-conflict dependency graphs at n=7 f=3
+        "tempo-hot": [
+            (gcp, gcp_regions[:7], pts("tempo", 7, 3, [100], 8,
+                                       clients=(2, 4)))
+        ],
+    }
+
+    # 5. the 10k joint sweep: Caesar + EPaxos x n x f x conflict x
+    # placement x seed (BASELINE.json configs[4])
+    joint = []
+    seeds = max(1, int(8 * args.joint_scale))
+    placements = [gcp_regions[i:i + 9] for i in (0, 5, 11)]
+    for regions in placements:
+        grid = []
+        for proto in ("caesar", "epaxos"):
+            for n in (3, 5, 7, 9):
+                fs = [1] if n == 3 else [1, 2]
+                for f in fs:
+                    for cf in (0, 10, 50, 100):
+                        grid += pts(proto, n, f, [cf], seeds, cmds=10)
+        joint.append((gcp, regions, grid))
+    grids["joint-10k"] = joint
+    return grids
+
+
+def run_milestones(args) -> int:
+    from fantoch_tpu.exp.harness import run_grid
+    from fantoch_tpu.plot.db import ResultsDB
+    from fantoch_tpu.plot import plots
+
+    grids = _milestone_grids(args)
+    names = list(grids) if args.milestone == "all" else [args.milestone]
+    results = {}
+    for name in names:
+        batches = grids[name]
+        results_root = os.path.join(args.out, name)
+        total = sum(len(b[2]) for b in batches)
+        t0 = time.time()
+        for bi, (planet, regions, points) in enumerate(batches):
+            nmax = max(pt.n for pt in points)
+            run_grid(
+                points,
+                planet=planet,
+                process_regions=regions[:nmax],
+                client_regions=[regions[0], regions[-1]],
+                results_root=results_root,
+                name=f"{name}_{bi}",
+                chunk_steps=args.chunk_steps,
+            )
+        wall = time.time() - t0
+        db = ResultsDB.load(results_root)
+        figdir = os.path.join(args.out, "figures")
+        os.makedirs(figdir, exist_ok=True)
+        protos = sorted({pt.protocol for b in batches for pt in b[2]})
+        series = {p: db.find(protocol=p) for p in protos}
+        fig = plots.throughput_latency_plot(
+            series, os.path.join(figdir, f"{name}.png")
+        )
+        results[name] = {
+            "configs": total,
+            "wall_s": round(wall, 1),
+            "configs_per_hour": round(total / wall * 3600.0, 1),
+            "figure": fig,
+        }
+        print(json.dumps({"milestone": name, **results[name]}))
+    print(json.dumps({"milestones": results}))
     return 0
 
 
