@@ -168,6 +168,7 @@ def _milestone_grids(args):
     aws_regions = list(aws.regions())
 
     def pts(proto, n, f, conflicts, seeds, clients=(2,), cmds=20, **kw):
+        seeds = max(1, int(seeds * args.scale))
         return [
             Point(protocol=proto, n=n, f=f, clients_per_region=c,
                   conflict_rate=cf, pool_size=1, commands_per_client=cmds,
@@ -203,7 +204,8 @@ def _milestone_grids(args):
     # 5. the 10k joint sweep: Caesar + EPaxos x n x f x conflict x
     # placement x seed (BASELINE.json configs[4])
     joint = []
-    seeds = max(1, int(8 * args.joint_scale))
+    # pts() scales by --scale; --joint-scale multiplies only this grid
+    seeds = 8 * args.joint_scale / max(args.scale, 1e-9)
     placements = [gcp_regions[i:i + 9] for i in (0, 5, 11)]
     for regions in placements:
         grid = []
@@ -212,7 +214,7 @@ def _milestone_grids(args):
                 fs = [1] if n == 3 else [1, 2]
                 for f in fs:
                     for cf in (0, 10, 50, 100):
-                        grid += pts(proto, n, f, [cf], seeds, cmds=10)
+                        grid += pts(proto, n, f, [cf], int(max(1, seeds)), cmds=10)
         joint.append((gcp, regions, grid))
     grids["joint-10k"] = joint
     return grids
