@@ -1,0 +1,39 @@
+"""Key-value store semantics: `KVOp::{Get, Put, Delete}` over dense stores.
+
+Reference parity (`fantoch/src/kvs.rs:13-85`): a command is a set of per-key
+operations; executing an op against the store returns the op's result —
+`Get` the current value, `Put` the previous value, `Delete` the removed
+value. On device a store is an int32 array indexed by dense key ids with 0
+meaning "absent" (`KVStore::execute` returning `None`), and values are the
+writing command's packed identity (`executors/ready.py writer_id` — the
+dense stand-in for the reference's opaque `Value` payload, sized by
+`Workload.payload_size` only on the wire).
+
+The workload generates `Get`s for read-only commands and `Put`s otherwise,
+like the reference's generator (`fantoch/src/client/workload.rs` builds
+`KVOp::Put(payload)` / reads); `Delete` completes the API surface and the
+unit tests mirror the reference's store flow (`kvs.rs:87-158`).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+GET = 0
+PUT = 1
+DELETE = 2
+
+ABSENT = jnp.int32(0)  # the dense `None`
+
+
+def execute(store_row: jnp.ndarray, key, op, arg, enable=True):
+    """Apply one op to a `[K]` store row; returns `(store_row', result)`.
+
+    `result` is the reference's `Option<Value>` as int32 (0 = None): the
+    current value for Get, the previous value for Put/Delete.
+    """
+    enable = jnp.asarray(enable)
+    old = jnp.sum(jnp.where(jnp.arange(store_row.shape[0]) == key, store_row, 0))
+    writes = enable & ((op == PUT) | (op == DELETE))
+    new_val = jnp.where(op == PUT, jnp.asarray(arg, jnp.int32), ABSENT)
+    mask = (jnp.arange(store_row.shape[0]) == key) & writes
+    return jnp.where(mask, new_val, store_row), jnp.where(enable, old, ABSENT)

@@ -183,6 +183,9 @@ class SimState(NamedTuple):
     c_done: jnp.ndarray  # [C] bool
     c_got: jnp.ndarray  # [C, CT] int32 partial results per outstanding cmd
     # (closed loop: CT=1, one outstanding; open loop: CT=commands_per_client)
+    c_vals: jnp.ndarray  # [C, CT, KPC] int32 per-key returned values of the
+    # outstanding command (the aggregated CommandResult contents,
+    # fantoch/src/executor/aggregate.rs + command.rs CommandResult)
     # client-side batcher (open loop + batch_max_size > 1)
     b_cnt: jnp.ndarray  # [C] int32 logical commands in the current batch
     b_first_rifl: jnp.ndarray  # [C] int32
@@ -235,6 +238,8 @@ def _cat_cands(blocks: Sequence[Candidates]) -> Candidates:
 
 
 def message_width(pdef: ProtocolDef, keys_per_command: int) -> int:
+    # floor: the submit payload (client, rifl, ro + KPC keys); the
+    # distributed runner raises its own floor for its partial-result record
     return max(pdef.msg_width, 3 + keys_per_command, 2)
 
 
@@ -393,6 +398,17 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         v = valid.reshape(R)
         cl = cclip.reshape(R)
         rs = rslot.reshape(R)
+        # aggregate per-key returned values into the client's CommandResult
+        # (AggregatePending::add_executor_result collecting partials). One
+        # scatter-max of R rows — exactly one valid partial exists per
+        # (command, kslot), and values are non-negative
+        ks = jnp.clip(res.kslot.reshape(R), 0, KPC - 1)
+        upd = (
+            jnp.full((C, CT, KPC), -1, jnp.int32)
+            .at[cl, rs, ks]
+            .max(jnp.where(v, res.value.reshape(R), -1))
+        )
+        st = st._replace(c_vals=jnp.where(upd >= 0, upd, st.c_vals))
         if KPC == 1:
             # one partial result per command: every valid result completes
             emit = valid
@@ -1062,6 +1078,8 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             valid=jnp.zeros((MR,), jnp.bool_),
             client=jnp.zeros((MR,), jnp.int32),
             rifl_seq=jnp.zeros((MR,), jnp.int32),
+            kslot=jnp.zeros((MR,), jnp.int32),
+            value=jnp.zeros((MR,), jnp.int32),
         )
 
     # ------------------------------------------------------------------
@@ -1133,6 +1151,7 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             c_sub_time=jnp.zeros((C, CT), jnp.int32),
             c_done=jnp.zeros((C,), jnp.bool_),
             c_got=jnp.zeros((C, CT), jnp.int32),
+            c_vals=jnp.zeros((C, CT, KPC), jnp.int32),
             b_cnt=jnp.zeros((C,), jnp.int32),
             b_first_rifl=jnp.zeros((C,), jnp.int32),
             b_first_time=jnp.zeros((C,), jnp.int32),

@@ -61,11 +61,18 @@ class ExecOut(NamedTuple):
 
 
 class ResOut(NamedTuple):
-    """Command results drained from an executor (Executor::to_clients)."""
+    """Command results drained from an executor (Executor::to_clients).
+
+    Each row is one per-key PARTIAL result (`ExecutorResult`,
+    fantoch/src/executor/mod.rs:170): `kslot` names the command's key slot
+    and `value` carries the op's returned value (core/kvs.py), aggregated
+    client-side into the CommandResult (AggregatePending)."""
 
     valid: jnp.ndarray  # [MAX_RES] bool
     client: jnp.ndarray  # [MAX_RES] int32
     rifl_seq: jnp.ndarray  # [MAX_RES] int32
+    kslot: jnp.ndarray  # [MAX_RES] int32
+    value: jnp.ndarray  # [MAX_RES] int32
 
 
 def empty_outbox(max_out: int, msg_w: int) -> Outbox:
@@ -103,6 +110,8 @@ def empty_resout(max_res: int) -> ResOut:
         valid=jnp.zeros((max_res,), jnp.bool_),
         client=jnp.zeros((max_res,), jnp.int32),
         rifl_seq=jnp.zeros((max_res,), jnp.int32),
+        kslot=jnp.zeros((max_res,), jnp.int32),
+        value=jnp.zeros((max_res,), jnp.int32),
     )
 
 

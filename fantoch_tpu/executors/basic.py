@@ -15,10 +15,11 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
+from ..core import kvs as kvs_mod
 from ..engine.types import ExecutorDef
 from .ready import ReadyRing, ready_capacity, ready_drain, ready_init, ready_push, writer_id
 
-EXEC_WIDTH = 3
+EXEC_WIDTH = 5
 
 
 class BasicExecState(NamedTuple):
@@ -35,9 +36,15 @@ def make_executor(n: int) -> ExecutorDef:
 
     def handle(ctx, est: BasicExecState, p, info, now):
         client, rifl_seq, key = info[0], info[1], info[2]
+        ro, kslot = info[3].astype(jnp.bool_), info[4]
+        op = jnp.where(ro, kvs_mod.GET, kvs_mod.PUT)
+        row, returned = kvs_mod.execute(
+            est.kvs[p], key, op, writer_id(client, rifl_seq)
+        )
         return est._replace(
-            kvs=est.kvs.at[p, key].set(writer_id(client, rifl_seq)),
-            ready=ready_push(est.ready, p, client, rifl_seq),
+            kvs=est.kvs.at[p].set(row),
+            ready=ready_push(est.ready, p, client, rifl_seq, kslot=kslot,
+                             value=returned),
         )
 
     def drain(ctx, est: BasicExecState, p):

@@ -34,6 +34,8 @@ def ready_capacity(spec) -> int:
 class ReadyRing(NamedTuple):
     client: jnp.ndarray  # [n, RQ] int32
     rifl_seq: jnp.ndarray  # [n, RQ] int32
+    kslot: jnp.ndarray  # [n, RQ] int32 key slot of this partial result
+    value: jnp.ndarray  # [n, RQ] int32 the op's returned value (kvs.py)
     push: jnp.ndarray  # [n] int32 total pushed
     pop: jnp.ndarray  # [n] int32 total popped
     overflow: jnp.ndarray  # [n] int32 pushes lost to a full ring (must stay 0)
@@ -43,13 +45,16 @@ def ready_init(n: int, capacity: int) -> ReadyRing:
     return ReadyRing(
         client=jnp.zeros((n, capacity), jnp.int32),
         rifl_seq=jnp.zeros((n, capacity), jnp.int32),
+        kslot=jnp.zeros((n, capacity), jnp.int32),
+        value=jnp.zeros((n, capacity), jnp.int32),
         push=jnp.zeros((n,), jnp.int32),
         pop=jnp.zeros((n,), jnp.int32),
         overflow=jnp.zeros((n,), jnp.int32),
     )
 
 
-def ready_push(ring: ReadyRing, p, client, rifl_seq, enable=True) -> ReadyRing:
+def ready_push(ring: ReadyRing, p, client, rifl_seq, enable=True, kslot=0,
+               value=0) -> ReadyRing:
     cap = ring.client.shape[1]
     enable = jnp.asarray(enable)
     full = (ring.push[p] - ring.pop[p]) >= cap
@@ -59,6 +64,12 @@ def ready_push(ring: ReadyRing, p, client, rifl_seq, enable=True) -> ReadyRing:
         client=ring.client.at[p, idx].set(jnp.where(do, client, ring.client[p, idx])),
         rifl_seq=ring.rifl_seq.at[p, idx].set(
             jnp.where(do, rifl_seq, ring.rifl_seq[p, idx])
+        ),
+        kslot=ring.kslot.at[p, idx].set(
+            jnp.where(do, jnp.asarray(kslot, jnp.int32), ring.kslot[p, idx])
+        ),
+        value=ring.value.at[p, idx].set(
+            jnp.where(do, jnp.asarray(value, jnp.int32), ring.value[p, idx])
         ),
         push=ring.push.at[p].add(do.astype(jnp.int32)),
         overflow=ring.overflow.at[p].add((enable & full).astype(jnp.int32)),
@@ -76,5 +87,7 @@ def ready_drain(ring: ReadyRing, p, max_res: int) -> Tuple[ReadyRing, ResOut]:
         valid=valid,
         client=ring.client[p, idx],
         rifl_seq=ring.rifl_seq[p, idx],
+        kslot=ring.kslot[p, idx],
+        value=ring.value[p, idx],
     )
     return ring._replace(pop=ring.pop.at[p].add(take)), res

@@ -147,6 +147,7 @@ class RState(NamedTuple):
     c_sub_time: jnp.ndarray  # [n, CM, CT] per-command issue time (open loop)
     c_done: jnp.ndarray
     c_got: jnp.ndarray  # [n, CM, CT] partial counts per outstanding rifl
+    c_vals: jnp.ndarray  # [n, CM, CT, KPC] per-key returned values
     lat_sum: jnp.ndarray
     lat_cnt: jnp.ndarray
     hist: jnp.ndarray  # [n, G, NB]
@@ -349,6 +350,7 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             c_sub_time=jnp.zeros((n, CM, CT), jnp.int32),
             c_done=jnp.zeros((n, CM), jnp.bool_),
             c_got=jnp.zeros((n, CM, CT), jnp.int32),
+            c_vals=jnp.zeros((n, CM, CT, KPC), jnp.int32),
             lat_sum=jnp.zeros((n, CM), jnp.int32),
             lat_cnt=jnp.zeros((n, CM), jnp.int32),
             hist=jnp.zeros((n, G, NB), jnp.int32),
@@ -511,7 +513,9 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
                 lenv.g2p[g],
                 L.st.now,
                 jnp.int32(RK_PARTIAL),
-                pad_payload([g, res.rifl_seq[i], myrow]),
+                pad_payload(
+                    [g, res.rifl_seq[i], myrow, res.kslot[i], res.value[i]]
+                ),
                 valid,
             )
         return L
@@ -684,11 +688,16 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             g = jnp.clip(payload[0], 0, C_TOTAL - 1)
             rifl = payload[1]
             emitter = jnp.clip(payload[2], 0, n - 1)
+            kslot = jnp.clip(payload[3], 0, KPC - 1)
+            value = payload[4]
             cslot = jnp.clip(lenv.g2s[g], 0, CM - 1)
             rslot = jnp.clip(rifl - 1, 0, CT - 1)
             got = st.c_got[0, cslot, rslot] + 1
             L = L._replace(
-                st=st._replace(c_got=st.c_got.at[0, cslot, rslot].set(got))
+                st=st._replace(
+                    c_got=st.c_got.at[0, cslot, rslot].set(got),
+                    c_vals=st.c_vals.at[0, cslot, rslot, kslot].set(value),
+                )
             )
             return send_push(
                 L, myrow, L.st.now + lenv.dist_pc[emitter, g],

@@ -134,6 +134,8 @@ def make_protocol(n: int, keys_per_command: int = 1, shards: int = 1) -> Protoco
                             ctx.cmds.client[sl],
                             ctx.cmds.rifl_seq[sl],
                             ctx.cmds.keys[sl, k],
+                            ctx.cmds.read_only[sl].astype(jnp.int32),
+                            jnp.int32(k),
                         ]
                     )
                     for k in range(KPC)
