@@ -72,6 +72,10 @@ def build_spec(
         f"{total_cmds} commands exceed the {1 << _ids.GSEQ_BITS}-sequence"
         " dot encoding (core/ids.py GSEQ_BITS)"
     )
+    assert n_clients < (1 << 15) and workload.commands_per_client < (1 << 16), (
+        "writer_id packs (client, rifl_seq) as client * 2^16 + rifl_seq in"
+        " one non-negative int32 (executors/ready.py)"
+    )
     if max_seq is None:
         # worst case: every command coordinated by one process
         max_seq = total_cmds

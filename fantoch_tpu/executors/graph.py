@@ -205,6 +205,7 @@ def make_executor(
             ).astype(jnp.int32)
             client = ctx.cmds.client[d]
             rifl = ctx.cmds.rifl_seq[d]
+            wr = ~ctx.cmds.read_only[d]  # Gets never mutate the store
             kvs, oh, oc, ready = e.kvs, e.order_hash, e.order_cnt, e.ready
             for k in range(KPC):
                 key = ctx.cmds.keys[d, k]
@@ -216,14 +217,16 @@ def make_executor(
                     if shards == 1
                     else key_shard(key, shards) == ctx.env.shard_of[ctx.pid]
                 )
+                old = kvs[p, key]
                 kvs = kvs.at[p, key].set(
-                    jnp.where(owned, writer_id(client, rifl), kvs[p, key])
+                    jnp.where(owned & wr, writer_id(client, rifl), old)
                 )
                 oh = oh.at[p, key].set(
                     jnp.where(owned, oh[p, key] * ORDER_HASH_MULT + (d + 1), oh[p, key])
                 )
                 oc = oc.at[p, key].add(owned.astype(jnp.int32))
-                ready = ready_push(ready, p, client, rifl, enable=owned)
+                ready = ready_push(ready, p, client, rifl, enable=owned,
+                                   kslot=k, value=old)
             e = e._replace(
                 kvs=kvs,
                 order_hash=oh,
@@ -286,6 +289,7 @@ def make_executor(
             fresh_exec = ~est.executed[p, sl]
             client = ctx.cmds.client[sl]
             rifl = ctx.cmds.rifl_seq[sl]
+            wr = ~ctx.cmds.read_only[sl]
             kvs, ready = est.kvs, est.ready
             for k in range(KPC):
                 key = ctx.cmds.keys[sl, k]
@@ -294,10 +298,12 @@ def make_executor(
                     if shards == 1
                     else key_shard(key, shards) == ctx.env.shard_of[ctx.pid]
                 )
+                old = kvs[p, key]
                 kvs = kvs.at[p, key].set(
-                    jnp.where(owned, writer_id(client, rifl), kvs[p, key])
+                    jnp.where(owned & wr, writer_id(client, rifl), old)
                 )
-                ready = ready_push(ready, p, client, rifl, enable=owned)
+                ready = ready_push(ready, p, client, rifl, enable=owned,
+                                   kslot=k, value=old)
             return est._replace(
                 kvs=kvs,
                 ready=ready,

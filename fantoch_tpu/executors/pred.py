@@ -97,12 +97,16 @@ def make_executor(n: int, max_seq: int, execute_at_commit: bool = False) -> Exec
             client = ctx.cmds.client[d]
             rifl = ctx.cmds.rifl_seq[d]
             kvs, oh, oc, ring = e.kvs, e.order_hash, e.order_cnt, e.ready
+            wr = ~ctx.cmds.read_only[d]
             for k in range(KPC):
                 key = ctx.cmds.keys[d, k]
-                kvs = kvs.at[p, key].set(writer_id(client, rifl))
+                old = kvs[p, key]
+                kvs = kvs.at[p, key].set(
+                    jnp.where(wr, writer_id(client, rifl), old)
+                )
                 oh = oh.at[p, key].set(oh[p, key] * ORDER_HASH_MULT + (d + 1))
                 oc = oc.at[p, key].add(1)
-                ring = ready_push(ring, p, client, rifl)
+                ring = ready_push(ring, p, client, rifl, kslot=k, value=old)
             return e._replace(
                 kvs=kvs,
                 order_hash=oh,
@@ -133,10 +137,14 @@ def make_executor(n: int, max_seq: int, execute_at_commit: bool = False) -> Exec
             client = ctx.cmds.client[dot]
             rifl = ctx.cmds.rifl_seq[dot]
             kvs, ring = est.kvs, est.ready
+            wr = ~ctx.cmds.read_only[dot]
             for k in range(KPC):
                 key = ctx.cmds.keys[dot, k]
-                kvs = kvs.at[p, key].set(writer_id(client, rifl))
-                ring = ready_push(ring, p, client, rifl)
+                old = kvs[p, key]
+                kvs = kvs.at[p, key].set(
+                    jnp.where(wr, writer_id(client, rifl), old)
+                )
+                ring = ready_push(ring, p, client, rifl, kslot=k, value=old)
             return est._replace(
                 kvs=kvs,
                 ready=ring,

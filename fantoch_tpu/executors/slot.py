@@ -54,10 +54,14 @@ def make_executor(n: int, execute_at_commit: bool = False) -> ExecutorDef:
             client = ctx.cmds.client[csl]
             rifl = ctx.cmds.rifl_seq[csl]
             kvs, ready = est.kvs, est.ready
+            wr = ~ctx.cmds.read_only[csl]
             for k in range(KPC):
                 key = ctx.cmds.keys[csl, k]
-                kvs = kvs.at[p, key].set(writer_id(client, rifl))
-                ready = ready_push(ready, p, client, rifl)
+                old = kvs[p, key]
+                kvs = kvs.at[p, key].set(
+                    jnp.where(wr, writer_id(client, rifl), old)
+                )
+                ready = ready_push(ready, p, client, rifl, kslot=k, value=old)
             return est._replace(kvs=kvs, ready=ready)
         est = est._replace(buf_dot=est.buf_dot.at[p, slot - 1].set(dot))
 
@@ -72,10 +76,14 @@ def make_executor(n: int, execute_at_commit: bool = False) -> ExecutorDef:
             client = ctx.cmds.client[d]
             rifl = ctx.cmds.rifl_seq[d]
             kvs, ready = e.kvs, e.ready
+            wr = ~ctx.cmds.read_only[d]
             for k in range(KPC):
                 key = ctx.cmds.keys[d, k]
-                kvs = kvs.at[p, key].set(writer_id(client, rifl))
-                ready = ready_push(ready, p, client, rifl)
+                old = kvs[p, key]
+                kvs = kvs.at[p, key].set(
+                    jnp.where(wr, writer_id(client, rifl), old)
+                )
+                ready = ready_push(ready, p, client, rifl, kslot=k, value=old)
             return e._replace(
                 kvs=kvs,
                 ready=ready,

@@ -210,8 +210,12 @@ def make_executor(n: int, shards: int = 1) -> ExecutorDef:
                 # only this shard's key slots produce table entries
                 myshard = ctx.env.shard_of[ctx.pid]
                 exp = (key_shard(ctx.cmds.keys[d], shards) == myshard).sum()
+            old = e.kvs[p, key]
+            wr = ~ctx.cmds.read_only[d]  # Gets never mutate the store
             return e._replace(
-                kvs=e.kvs.at[p, key].set(writer_id(client, rifl)),
+                kvs=e.kvs.at[p, key].set(
+                    jnp.where(wr, writer_id(client, rifl), old)
+                ),
                 tbl_pending=e.tbl_pending.at[p, d, kslot].set(False),
                 done_cnt=e.done_cnt.at[p, d].set(done),
                 executed=e.executed.at[p, d].set(done == exp),
@@ -220,7 +224,8 @@ def make_executor(n: int, shards: int = 1) -> ExecutorDef:
                 ),
                 order_cnt=e.order_cnt.at[p, key].add(1),
                 executed_count=e.executed_count.at[p].add(1),
-                ready=ready_push(e.ready, p, client, rifl),
+                ready=ready_push(e.ready, p, client, rifl, kslot=kslot,
+                                 value=old),
             )
 
         est = jax.lax.while_loop(cond, body, est)

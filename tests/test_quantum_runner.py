@@ -119,6 +119,15 @@ def _run_both_engines(pdef, config, wl=None):
     rst = jax.tree_util.tree_map(np.asarray, rst)
     assert int(rst.dropped.sum()) == 0 and bool(rst.all_done)
     np.testing.assert_array_equal(rst.hist.sum(axis=0), st.hist)
+    # CommandResult contents: the per-key returned values the two engines
+    # aggregated must agree exactly (core/kvs.py semantics)
+    g2p = np.asarray(runner.lenv.g2p)
+    g2s = np.asarray(runner.lenv.g2s)
+    for c in range(spec.n_clients):
+        np.testing.assert_array_equal(
+            rst.c_vals[int(g2p[c]), int(g2s[c])], st.c_vals[c],
+            err_msg=f"client {c} returned-value divergence",
+        )
     return st, rst
 
 
