@@ -111,6 +111,11 @@ class SimSpec:
     # key, which leaves the conflict set identical to the reference's merge.
     batch_max_size: int = 1
     batch_max_delay_ms: int = 0
+    # deterministic ×[0,10) reorder from a hash of each message's unique
+    # sequence number (delay = base * (murmur32(seq ^ salt) % 100) // 10):
+    # bit-reproducible by the native C++ oracle (native/atlas_oracle.cpp),
+    # unlike `reorder`'s device PRNG — used by oracle-equality tests
+    reorder_hash: bool = False
 
     @property
     def dots(self) -> int:
@@ -220,6 +225,24 @@ class Candidates(NamedTuple):
     payload: jnp.ndarray  # [CN, W] int32
 
 
+def _hash_mult_x10(seq: jnp.ndarray, salt: jnp.ndarray) -> jnp.ndarray:
+    """×10 delay multiplier in [0, 100) from a murmur3-finalizer hash of a
+    message's unique sequence number (the deterministic reorder mode; the
+    native oracle computes the identical uint32 arithmetic)."""
+    x = seq.astype(jnp.uint32) ^ salt.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return (x % jnp.uint32(100)).astype(jnp.int32)
+
+
+def reorder_salt(env: "Env") -> jnp.ndarray:
+    """The uint32 salt of the hash-reorder mode for one config's Env."""
+    return (env.seed[0] ^ env.seed[1]).astype(jnp.uint32)
+
+
 def _tree_select(pred, a, b):
     return jax.tree_util.tree_map(lambda x, y: jnp.where(pred, x, y), a, b)
 
@@ -306,11 +329,14 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
                 jnp.floor(base.astype(jnp.float32) * u).astype(jnp.int32),
                 base,
             )
+        crank = jnp.cumsum(cand.valid) - 1  # [CN]
+        if spec.reorder_hash:
+            mult = _hash_mult_x10(st.seqno + crank, reorder_salt(env))
+            base = jnp.where(cand.net, base * mult // 10, base)
         time = st.now + base
         free = ~st.m_valid
         frank = jnp.cumsum(free) - 1  # [S] rank among free slots
         n_free = free.sum()
-        crank = jnp.cumsum(cand.valid) - 1  # [CN]
         okc = cand.valid & (crank < n_free)
         # assignment matrix: candidate c -> the free slot with matching rank
         A = free[:, None] & (frank[:, None] == crank[None, :]) & okc[None, :]
@@ -1178,6 +1204,13 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
                 st.m_time[:C].astype(jnp.float32) * u
             ).astype(jnp.int32)
             st = st._replace(m_time=st.m_time.at[:C].set(t0))
+        if spec.reorder_hash and not OPEN:
+            mult = _hash_mult_x10(
+                jnp.arange(C, dtype=jnp.int32), reorder_salt(env)
+            )
+            st = st._replace(
+                m_time=st.m_time.at[:C].set(st.m_time[:C] * mult // 10)
+            )
         return st
 
     def cond(st: SimState):

@@ -36,6 +36,7 @@ def build_spec(
     hist_buckets: int = 2048,
     extra_ms: int = 1000,
     reorder: bool = False,
+    reorder_hash: bool = False,
     max_steps: int = 1 << 30,
     max_res: int = 4,
     open_loop_interval_ms: Optional[int] = None,
@@ -54,6 +55,11 @@ def build_spec(
         )
     assert config.gc_interval_ms is not None, (
         "the simulator requires gc to be running (reference runner.rs:75)"
+    )
+    assert not (reorder and reorder_hash), (
+        "reorder (device PRNG) and reorder_hash (deterministic, oracle-"
+        "reproducible) are alternative delay-multiplier modes; enabling both"
+        " would compose two x[0,10) multipliers"
     )
     n_total = config.n * config.shard_count
     assert pdef.shards == config.shard_count, (
@@ -137,6 +143,7 @@ def build_spec(
         cleanup_ms=config.executor_cleanup_interval_ms,
         extra_ms=extra_ms,
         reorder=reorder,
+        reorder_hash=reorder_hash,
         max_steps=max_steps,
         max_res=max_res,
         open_loop_interval_ms=open_loop_interval_ms,

@@ -133,3 +133,103 @@ def sim_fpaxos_oracle(*, wq_size: int, leader: int, wq_mask, **kw) -> dict:
     return _run_oracle(
         "sim_fpaxos", protocol_args=(wq_size, leader), quorum_mask=wq_mask, **kw
     )
+
+
+def sim_atlas_oracle(
+    *,
+    n: int,
+    n_clients: int,
+    keys_per_command: int,
+    max_seq: int,
+    commands_per_client: int,
+    variant: int,  # 0 = atlas/janus, 1 = epaxos
+    wq_size: int,
+    max_res: int,
+    extra_ms: int,
+    gc_interval_ms: int,
+    executed_ms: int,
+    cleanup_ms: int,
+    reorder_hash: bool,
+    salt: int,
+    key_space: int,
+    max_steps: int,
+    dist_pp,
+    dist_pc,
+    dist_cp,
+    client_proc,
+    fq_mask,
+    wq_mask,
+    keys,  # [C, CMDS, KPC] workload keys per (client, command index)
+    read_only,  # [C, CMDS] 0/1
+) -> dict:
+    """Run the native Atlas/EPaxos oracle: dependency-graph consensus with
+    the graph executor and windowed GC (native/atlas_oracle.cpp), under the
+    deterministic hash-reorder mode when `reorder_hash` is set. Returns
+    latencies, protocol counters, per-(process, key) execution-order hashes
+    and the clients' final returned values."""
+    lib = load()
+    fn = lib.sim_atlas
+    fn.restype = ctypes.c_int
+    C, K = n_clients, key_space
+    dist_pp = _i32(dist_pp)
+    dist_pc = _i32(dist_pc)
+    dist_cp = _i32(dist_cp)
+    client_proc = _i32(client_proc)
+    fq_mask = _i32(fq_mask)
+    wq_mask = _i32(wq_mask)
+    keys = _i32(keys)
+    read_only = _i32(read_only)
+    assert dist_pp.shape == (n, n) and dist_pc.shape == (n, C)
+    assert dist_cp.shape == (C,) and client_proc.shape == (C,)
+    assert fq_mask.shape == (n,) and wq_mask.shape == (n,)
+    assert keys.shape == (C, commands_per_client, keys_per_command)
+    assert read_only.shape == (C, commands_per_client)
+
+    iparams = _i32(
+        [
+            n, C, keys_per_command, max_seq, commands_per_client, variant,
+            wq_size, max_res, extra_ms, gc_interval_ms, executed_ms,
+            cleanup_ms, int(bool(reorder_hash)),
+            np.int32(np.uint32(salt & 0xFFFFFFFF)), K,
+        ]
+    )
+    lat_sum = np.zeros(C, np.int64)
+    lat_cnt = np.zeros(C, np.int32)
+    commit_count = np.zeros(n, np.int32)
+    stable_count = np.zeros(n, np.int32)
+    fast_count = np.zeros(n, np.int32)
+    slow_count = np.zeros(n, np.int32)
+    order_hash = np.zeros((n, K), np.int32)
+    order_cnt = np.zeros((n, K), np.int32)
+    c_vals = np.zeros((C, keys_per_command), np.int32)
+    steps = ctypes.c_longlong(0)
+
+    def ptr(a, t):
+        return a.ctypes.data_as(ctypes.POINTER(t))
+
+    rc = fn(
+        ptr(iparams, ctypes.c_int32), ctypes.c_longlong(max_steps),
+        ptr(dist_pp, ctypes.c_int32), ptr(dist_pc, ctypes.c_int32),
+        ptr(dist_cp, ctypes.c_int32), ptr(client_proc, ctypes.c_int32),
+        ptr(fq_mask, ctypes.c_int32), ptr(wq_mask, ctypes.c_int32),
+        ptr(keys, ctypes.c_int32), ptr(read_only, ctypes.c_int32),
+        ptr(lat_sum, ctypes.c_longlong), ptr(lat_cnt, ctypes.c_int32),
+        ptr(commit_count, ctypes.c_int32), ptr(stable_count, ctypes.c_int32),
+        ptr(fast_count, ctypes.c_int32), ptr(slow_count, ctypes.c_int32),
+        ptr(order_hash, ctypes.c_int32), ptr(order_cnt, ctypes.c_int32),
+        ptr(c_vals, ctypes.c_int32), ctypes.byref(steps),
+    )
+    if rc != 0:
+        raise RuntimeError(f"sim_atlas oracle failed with code {rc}")
+    return {
+        "lat_sum": lat_sum,
+        "lat_cnt": lat_cnt,
+        "commit_count": commit_count,
+        "stable_count": stable_count,
+        "fast_count": fast_count,
+        "slow_count": slow_count,
+        "order_hash": order_hash,
+        "order_cnt": order_cnt,
+        "c_vals": c_vals,
+        "steps": int(steps.value),
+    }

@@ -1,0 +1,850 @@
+// Native Atlas/EPaxos oracle: dependency-graph consensus + graph executor.
+//
+// An independent reimplementation of the framework's Atlas protocol
+// (fantoch_tpu/protocols/atlas.py), graph executor (executors/graph.py) and
+// windowed GC (protocols/common/gc.py) — in the style of the reference's
+// architecture (reference: fantoch_ps/src/protocol/atlas.rs +
+// fantoch_ps/src/executor/graph/) but against this framework's engine
+// contract. Where the device engine computes ready commands with a
+// transitive closure by boolean matrix squaring over the ring window
+// (executors/graph.py _try_execute), this oracle uses per-vertex DFS
+// reachability over map-based vertices — different algorithm, same spec:
+// equality of execution order is exactly what the test asserts.
+//
+// Scheduling mirrors the instant-batched engine (engine/lockstep.py):
+// each outer iteration advances `now` to the minimum of eligible message
+// times and periodic timers, delivers messages in sub-rounds (every process
+// handles its earliest-sequence deliverable message, clients likewise, new
+// zero-delay messages join the next sub-round), then fires all due periodic
+// slots. Message sequence numbers are assigned in the engine's candidate
+// order (protocol outboxes process-major/row/destination, then executor
+// replies, then client submits), so deterministic tie-breaks coincide.
+//
+// Reorder: the engine's hash-reorder mode (SimSpec.reorder_hash) derives a
+// x[0,10) delay multiplier from a murmur3-finalizer hash of the message's
+// unique sequence number — reproduced here with identical uint32 arithmetic.
+//
+// Built into libfantoch_native.so; driven via ctypes
+// (fantoch_tpu/utils/native.py sim_atlas_oracle).
+
+#include <algorithm>
+#include <cstdint>
+#include <map>
+#include <set>
+#include <vector>
+
+namespace {
+
+constexpr int64_t INF_TIME = int64_t(1) << 30;
+constexpr int GSEQ_BITS = 21;
+constexpr int32_t GSEQ_MASK = (1 << GSEQ_BITS) - 1;
+
+// engine message kinds (engine/types.py)
+constexpr int KIND_SUBMIT = 0;
+constexpr int KIND_TO_CLIENT = 1;
+constexpr int KIND_PROTO_BASE = 3;
+
+// Atlas message kinds (protocols/atlas.py)
+constexpr int A_MCOLLECT = 0;
+constexpr int A_MCOLLECTACK = 1;
+constexpr int A_MCOMMIT = 2;
+constexpr int A_MCONSENSUS = 3;
+constexpr int A_MCONSENSUSACK = 4;
+constexpr int A_MGC = 5;
+
+// dot status (protocols/atlas.py)
+constexpr int ST_START = 0;
+constexpr int ST_PAYLOAD = 1;
+constexpr int ST_COLLECT = 2;
+constexpr int ST_COMMIT = 3;
+
+constexpr uint32_t ORDER_HASH_MULT = 0x01000193u;
+
+inline int32_t dot_make(int32_t proc, int32_t seq) {
+  return (proc << GSEQ_BITS) | ((seq - 1) & GSEQ_MASK);
+}
+inline int32_t dot_proc(int32_t dot) { return dot >> GSEQ_BITS; }
+inline int32_t dot_seq(int32_t dot) { return (dot & GSEQ_MASK) + 1; }
+
+// murmur3 finalizer — identical to lockstep.py _hash_mult_x10
+inline int32_t hash_mult_x10(uint32_t seq, uint32_t salt) {
+  uint32_t x = seq ^ salt;
+  x ^= x >> 16;
+  x *= 0x85EBCA6Bu;
+  x ^= x >> 13;
+  x *= 0xC2B2AE35u;
+  x ^= x >> 16;
+  return int32_t(x % 100u);
+}
+
+struct Msg {
+  int64_t time;
+  int64_t seq;
+  int32_t src, dst, kind;
+  std::vector<int32_t> payload;
+  bool alive = true;
+};
+
+// one per-dot protocol registry entry (the dense [n, DOTS] SoA rows of
+// AtlasState, keyed by dot here)
+struct PDot {
+  int status = ST_START;
+  int qsize = 0;
+  int qd_count = 0;                  // QuorumDeps participants
+  std::map<int32_t, int> qd;         // dep -> report count
+  std::set<int32_t> acc_deps;        // committed / consensus deps
+  std::set<int32_t> prop_deps;       // slow-path proposal
+  bool bufc_valid = false;
+  std::set<int32_t> bufc_deps;
+  // synod (protocols/common/synod.py; value rides in acc_deps/prop_deps)
+  int32_t acc_bal = 0, acc_abal = 0;
+  int32_t prop_bal = 0;
+  uint32_t prop_acks = 0;  // sender bitmask
+};
+
+// one graph-executor vertex (executors/graph.py ring-slot state, keyed by
+// dot; slot aliasing resolved by evicting the old generation on overwrite)
+struct Vertex {
+  std::set<int32_t> deps;
+  bool executed = false;
+};
+
+struct AtlasSim {
+  // ---- config ----
+  int n, C, kpc, W, cmds, variant, wq_size, max_res, extra_ms;
+  int gc_ms, executed_ms, cleanup_ms, key_space;
+  bool reorder_hash;
+  uint32_t salt;
+  int64_t max_steps;
+  const int32_t *dist_pp, *dist_pc, *dist_cp, *client_proc;
+  const int32_t *fq_mask, *wq_mask;
+  const int32_t *wl_keys;  // [C, cmds, kpc]
+  const int32_t *wl_ro;    // [C, cmds]
+
+  bool self_ack() const { return variant == 0; }  // atlas/janus vs epaxos
+
+  // ---- engine state ----
+  std::vector<Msg> pool;
+  int64_t now = 0, step = 0, seqno = 0;
+  std::vector<std::vector<int64_t>> per_next;  // [n][3] gc/executed/cleanup
+  bool all_done = false;
+  int64_t final_time = INF_TIME;
+  int clients_done = 0;
+
+  // command table keyed by ring slot (mirrors the engine's dense table)
+  struct Cmd {
+    int32_t client = 0, rifl = 0;
+    std::vector<int32_t> keys;
+    bool ro = false;
+  };
+  std::vector<Cmd> cmd_tab;  // [n * W]
+  std::vector<int32_t> next_seq;  // [n] 1-based
+
+  // clients (closed loop)
+  std::vector<int64_t> c_start, lat_sum;
+  std::vector<int32_t> c_issued, c_got, lat_cnt;
+  std::vector<bool> c_done;
+  std::vector<std::vector<int32_t>> c_vals;  // [C][kpc]
+
+  // protocol per-process state
+  std::vector<std::map<int32_t, PDot>> dots;       // [n] dot -> PDot
+  std::vector<std::vector<int32_t>> latest_w, latest_r;  // [n][K] dot+1
+  std::vector<int32_t> fast_cnt, slow_cnt, commit_cnt;
+
+  // GC track (protocols/common/gc.py, set-based)
+  std::vector<std::vector<std::set<int32_t>>> gc_committed;  // [n][coord] seqs > frontier
+  std::vector<std::vector<int32_t>> gc_frontier;    // [n][coord] contiguous committed
+  std::vector<std::vector<int64_t>> gc_exec_fr;     // [n][coord] INF until noted
+  std::vector<std::vector<std::vector<int32_t>>> clock_of;   // [n][src][coord]
+  std::vector<std::vector<bool>> heard_from;        // [n][src]
+  std::vector<std::vector<int32_t>> stable_wm;      // [n][coord]
+  std::vector<std::vector<std::vector<int32_t>>> stable_of;  // [n][src][coord]
+  std::vector<int32_t> stable_cnt;                  // [n]
+
+  // graph executor per-process state
+  std::vector<std::map<int32_t, Vertex>> verts;     // [n] dot -> vertex
+  std::vector<std::map<int32_t, int32_t>> slot_own; // [n] slot -> dot
+  std::vector<std::vector<int32_t>> ex_frontier;    // [n][coord] contiguous executed
+  std::vector<std::vector<uint32_t>> order_hash;    // [n][K]
+  std::vector<std::vector<int32_t>> order_cnt;      // [n][K]
+  struct Res { int32_t client, rifl, kslot, value; };
+  std::vector<std::vector<Res>> ready;              // [n] FIFO
+  std::vector<size_t> ready_pop;
+  std::vector<std::vector<int32_t>> kvs;            // [n][K]
+
+  void init() {
+    per_next.assign(n, {int64_t(gc_ms), int64_t(executed_ms), int64_t(cleanup_ms)});
+    cmd_tab.assign(size_t(n) * W, {});
+    next_seq.assign(n, 1);
+    c_start.assign(C, 0);
+    lat_sum.assign(C, 0);
+    c_issued.assign(C, 1);
+    c_got.assign(C, 0);
+    lat_cnt.assign(C, 0);
+    c_done.assign(C, false);
+    c_vals.assign(C, std::vector<int32_t>(kpc, 0));
+    dots.assign(n, {});
+    latest_w.assign(n, std::vector<int32_t>(key_space, 0));
+    latest_r.assign(n, std::vector<int32_t>(key_space, 0));
+    fast_cnt.assign(n, 0);
+    slow_cnt.assign(n, 0);
+    commit_cnt.assign(n, 0);
+    gc_committed.assign(n, std::vector<std::set<int32_t>>(n));
+    gc_frontier.assign(n, std::vector<int32_t>(n, 0));
+    gc_exec_fr.assign(n, std::vector<int64_t>(n, INF_TIME));
+    clock_of.assign(n, std::vector<std::vector<int32_t>>(n, std::vector<int32_t>(n, 0)));
+    heard_from.assign(n, std::vector<bool>(n, false));
+    stable_wm.assign(n, std::vector<int32_t>(n, 0));
+    stable_of.assign(n, std::vector<std::vector<int32_t>>(n, std::vector<int32_t>(n, 0)));
+    stable_cnt.assign(n, 0);
+    verts.assign(n, {});
+    slot_own.assign(n, {});
+    ex_frontier.assign(n, std::vector<int32_t>(n, 0));
+    order_hash.assign(n, std::vector<uint32_t>(key_space, 0));
+    order_cnt.assign(n, std::vector<int32_t>(key_space, 0));
+    ready.assign(n, {});
+    ready_pop.assign(n, 0);
+    kvs.assign(n, std::vector<int32_t>(key_space, 0));
+
+    // initial closed-loop submits: slot c gets sequence number c
+    for (int c = 0; c < C; c++) {
+      int64_t t = dist_cp[c];
+      if (reorder_hash) t = t * hash_mult_x10(uint32_t(c), salt) / 10;
+      std::vector<int32_t> pay = {c, 1, wl_ro[size_t(c) * cmds + 0]};
+      for (int k = 0; k < kpc; k++)
+        pay.push_back(wl_keys[(size_t(c) * cmds + 0) * kpc + k]);
+      pool.push_back(Msg{t, c, c, client_proc[c], KIND_SUBMIT, pay});
+    }
+    seqno = C;
+  }
+
+  // ------------------------------------------------------------------
+  // candidate insertion (the engine's _insert, sequential)
+  // ------------------------------------------------------------------
+  void insert(int64_t base, bool net, int src, int dst, int kind,
+              std::vector<int32_t> payload) {
+    int64_t s = seqno++;
+    if (net && reorder_hash)
+      base = base * hash_mult_x10(uint32_t(s), salt) / 10;
+    pool.push_back(Msg{now + base, s, src, dst, kind, std::move(payload)});
+  }
+
+  // pending candidates of one sub-round / periodic batch. The engine
+  // sequences one batch's candidates as: all protocol outbox messages
+  // (process-major), then all executor replies (process-major), then client
+  // submits (client order) — three buffers flushed in that order so message
+  // sequence numbers (the deterministic tie-break) coincide exactly.
+  struct Cand {
+    int64_t base;
+    bool net;
+    int src, dst, kind;
+    std::vector<int32_t> payload;
+  };
+  std::vector<Cand> proto_cands, reply_cands, sub_cands;
+  void cand_proto(int64_t base, int src, int dst, int kind,
+                  std::vector<int32_t> payload) {
+    proto_cands.push_back(Cand{base, true, src, dst, kind, std::move(payload)});
+  }
+  void cand_reply(int64_t base, int src, int dst,
+                  std::vector<int32_t> payload) {
+    reply_cands.push_back(
+        Cand{base, true, src, dst, KIND_TO_CLIENT, std::move(payload)});
+  }
+  void cand_sub(int64_t base, int src, int dst, std::vector<int32_t> payload) {
+    sub_cands.push_back(Cand{base, true, src, dst, KIND_SUBMIT, std::move(payload)});
+  }
+  void flush_cands() {
+    for (auto* buf : {&proto_cands, &reply_cands, &sub_cands}) {
+      for (auto& c : *buf)
+        insert(c.base, c.net, c.src, c.dst, c.kind, std::move(c.payload));
+      buf->clear();
+    }
+  }
+
+  // broadcast a protocol message to a target bitmask, dst-ascending (the
+  // engine's _expand_outbox candidate order within one outbox row)
+  void send_proto(int src, uint32_t tgt_mask, int kind,
+                  const std::vector<int32_t>& payload) {
+    for (int dst = 0; dst < n; dst++)
+      if ((tgt_mask >> dst) & 1u)
+        cand_proto(dist_pp[src * n + dst], src, dst, KIND_PROTO_BASE + kind,
+                   payload);
+  }
+
+  // ------------------------------------------------------------------
+  // GC (protocols/common/gc.py with window compaction)
+  // ------------------------------------------------------------------
+  bool gc_live(int p, int32_t dot) const {
+    return dot_seq(dot) > stable_wm[p][dot_proc(dot)];
+  }
+
+  void gc_commit(int p, int32_t dot) {
+    int a = dot_proc(dot), s = dot_seq(dot);
+    if (s > gc_frontier[p][a]) gc_committed[p][a].insert(s);
+    int32_t& fr = gc_frontier[p][a];
+    while (gc_committed[p][a].count(fr + 1)) {
+      gc_committed[p][a].erase(fr + 1);
+      fr++;
+    }
+  }
+
+  int32_t report_row(int p, int a) const {  // gc_report_row
+    return int32_t(std::min<int64_t>(gc_frontier[p][a], gc_exec_fr[p][a]));
+  }
+
+  int32_t window_floor(int p) const {  // gc_floor for coordinator p
+    int32_t fl = stable_wm[p][p];
+    for (int q = 0; q < n; q++)
+      if (q != p) fl = std::min(fl, stable_of[p][q][p]);
+    return fl;
+  }
+
+  bool can_alloc(int p) const { return next_seq[p] <= window_floor(p) + W; }
+
+  void handle_mgc(int p, int src, const std::vector<int32_t>& pl) {
+    for (int a = 0; a < n; a++) {
+      clock_of[p][src][a] = std::max(clock_of[p][src][a], pl[a]);
+      stable_of[p][src][a] = std::max(stable_of[p][src][a], pl[n + a]);
+    }
+    heard_from[p][src] = true;
+    bool all_heard = true;
+    for (int q = 0; q < n; q++)
+      if (q != p && !heard_from[p][q]) all_heard = false;
+    if (!all_heard) return;
+    for (int a = 0; a < n; a++) {
+      int32_t peer_min = INT32_MAX;
+      for (int q = 0; q < n; q++)
+        if (q != p) peer_min = std::min(peer_min, clock_of[p][q][a]);
+      int32_t own = report_row(p, a);
+      int32_t stable = std::min(own, peer_min);
+      int32_t old_wm = stable_wm[p][a];
+      int32_t new_wm = std::max(old_wm, stable);
+      if (new_wm > old_wm) {
+        stable_cnt[p] += new_wm - old_wm;
+        stable_wm[p][a] = new_wm;
+        // _clear_slots: recycle the newly-stable dots' protocol state
+        for (int32_t s = old_wm + 1; s <= new_wm; s++)
+          dots[p].erase(dot_make(a, s));
+      }
+    }
+  }
+
+  // ------------------------------------------------------------------
+  // KeyDeps (protocols/common/deps.py add_cmd; nfr = false)
+  // ------------------------------------------------------------------
+  std::set<int32_t> add_cmd(int p, int32_t dot, const Cmd& cmd,
+                            std::set<int32_t> past) {
+    for (int i = 0; i < kpc; i++) {
+      int32_t k = cmd.keys[i];
+      if (latest_w[p][k] > 0) past.insert(latest_w[p][k] - 1);
+      if (!cmd.ro && latest_r[p][k] > 0) past.insert(latest_r[p][k] - 1);
+      if (!cmd.ro)
+        latest_w[p][k] = dot + 1;
+      else
+        latest_r[p][k] = dot + 1;
+    }
+    return past;
+  }
+
+  // ------------------------------------------------------------------
+  // graph executor (executors/graph.py)
+  // ------------------------------------------------------------------
+  bool dep_done(int p, int32_t dep) const {
+    return dot_seq(dep) <= ex_frontier[p][dot_proc(dep)];
+  }
+
+  void exec_ingest(int p, int32_t dot, const std::set<int32_t>& deps) {
+    int32_t slot = dot_proc(dot) * W + (dot_seq(dot) - 1) % W;
+    auto it = slot_own[p].find(slot);
+    if (it != slot_own[p].end() && it->second != dot)
+      verts[p].erase(it->second);  // evict the old generation (ring reuse)
+    slot_own[p][slot] = dot;
+    auto& v = verts[p][dot];  // fresh insert resets executed = false
+    v.deps = deps;
+    try_execute(p);
+  }
+
+  void try_execute(int p) {
+    // snapshot semantics of the engine's _try_execute: V, bad, reach, U and
+    // the execution order are computed from entry state; the frontier
+    // advances once at the end
+    std::vector<int32_t> V;
+    for (auto& [d, v] : verts[p])
+      if (!v.executed) V.push_back(d);
+    if (V.empty()) return;
+    std::map<int32_t, int> idx;
+    for (size_t i = 0; i < V.size(); i++) idx[V[i]] = int(i);
+    size_t m = V.size();
+    std::vector<char> bad(m, 0);
+    std::vector<std::vector<int>> adj(m);
+    for (size_t i = 0; i < m; i++) {
+      for (int32_t dep : verts[p][V[i]].deps) {
+        if (dep_done(p, dep)) continue;
+        auto it = verts[p].find(dep);
+        if (it == verts[p].end()) {
+          bad[i] = 1;  // neither done nor live in the window
+        } else if (!it->second.executed) {
+          adj[i].push_back(idx[dep]);
+        }  // executed out-of-frontier-order: satisfied, no edge
+      }
+    }
+    // reach sets by DFS (windows are small; the device engine squares the
+    // adjacency matrix instead — same closure)
+    std::vector<std::vector<char>> reach(m, std::vector<char>(m, 0));
+    for (size_t i = 0; i < m; i++) {
+      std::vector<int> stack(adj[i].begin(), adj[i].end());
+      while (!stack.empty()) {
+        int j = stack.back();
+        stack.pop_back();
+        if (reach[i][j]) continue;
+        reach[i][j] = 1;
+        for (int k2 : adj[j]) stack.push_back(k2);
+      }
+    }
+    std::vector<char> blocked(m, 0);
+    for (size_t i = 0; i < m; i++) {
+      blocked[i] = bad[i];
+      for (size_t j = 0; j < m && !blocked[i]; j++)
+        if (reach[i][j] && bad[j]) blocked[i] = 1;
+    }
+    std::vector<char> U(m, 0);
+    for (size_t i = 0; i < m; i++) U[i] = !blocked[i];
+    // rank(u) = |reach(u) u {u}| within U (executors/graph.py); execute
+    // ascending (rank, dot) — in-SCC ties break by dot like the reference
+    std::vector<std::pair<int32_t, int32_t>> order;  // (rank, dot)
+    for (size_t i = 0; i < m; i++) {
+      if (!U[i]) continue;
+      int32_t rank = 1;  // self (i in U)
+      for (size_t j = 0; j < m; j++)
+        if (j != i && reach[i][j] && U[j]) rank++;
+      order.push_back({rank, V[i]});
+    }
+    std::sort(order.begin(), order.end());
+    for (auto& [rank, d] : order) {
+      (void)rank;
+      int32_t slot = dot_proc(d) * W + (dot_seq(d) - 1) % W;
+      const Cmd& cmd = cmd_tab[slot];
+      for (int k = 0; k < kpc; k++) {
+        int32_t key = cmd.keys[k];
+        int32_t old = kvs[p][key];
+        if (!cmd.ro) kvs[p][key] = cmd.client * (1 << 16) + cmd.rifl;
+        order_hash[p][key] = order_hash[p][key] * ORDER_HASH_MULT + uint32_t(slot + 1);
+        order_cnt[p][key]++;
+        ready[p].push_back({cmd.client, cmd.rifl, k, old});
+      }
+      verts[p][d].executed = true;
+    }
+    // advance the contiguous executed frontier per coordinator
+    for (int a = 0; a < n; a++) {
+      int32_t& fr = ex_frontier[p][a];
+      for (;;) {
+        auto it = verts[p].find(dot_make(a, fr + 1));
+        if (it == verts[p].end() || !it->second.executed) break;
+        fr++;
+      }
+    }
+  }
+
+  // drain up to max_res ready results and route them (the engine drains
+  // after every handler call and on cleanup ticks; _route_results)
+  void drain_and_route(int p) {
+    int take = int(std::min<size_t>(ready[p].size() - ready_pop[p], size_t(max_res)));
+    for (int i = 0; i < take; i++) {
+      const Res& r = ready[p][ready_pop[p] + i];
+      if (client_proc[r.client] != p) continue;  // not the submitting process
+      c_vals[r.client][r.kslot] = r.value;
+      if (++c_got[r.client] == kpc)
+        cand_reply(dist_pc[p * C + r.client], p, r.client,
+                   {r.client, r.rifl});
+    }
+    ready_pop[p] += take;
+    if (ready_pop[p] == ready[p].size()) {
+      ready[p].clear();
+      ready_pop[p] = 0;
+    }
+  }
+
+  // ------------------------------------------------------------------
+  // Atlas protocol handlers (protocols/atlas.py, single shard)
+  // ------------------------------------------------------------------
+  void commit(int p, int32_t dot, const std::set<int32_t>& deps) {
+    PDot& info = dots[p][dot];
+    info.status = ST_COMMIT;
+    info.acc_deps = deps;
+    commit_cnt[p]++;
+    gc_commit(p, dot);
+    exec_ingest(p, dot, deps);  // ExecOut -> executor handle
+  }
+
+  void handle_submit(const Msg& ev) {
+    int p = ev.dst;
+    int32_t client = ev.payload[0], rifl = ev.payload[1];
+    // pre-phase: register the command (eligibility guaranteed can_alloc)
+    int32_t seq = next_seq[p]++;
+    int32_t dot = dot_make(p, seq);
+    int32_t slot = p * W + (seq - 1) % W;
+    Cmd& cmd = cmd_tab[slot];
+    cmd.client = client;
+    cmd.rifl = rifl;
+    cmd.ro = ev.payload[2] != 0;
+    cmd.keys.assign(ev.payload.begin() + 3, ev.payload.begin() + 3 + kpc);
+    c_got[client] = 0;
+    // Atlas submit: deps from own latests, MCollect to all
+    std::set<int32_t> deps = add_cmd(p, dot, cmd, {});
+    std::vector<int32_t> pay = {dot, fq_mask[p]};
+    pay.insert(pay.end(), deps.begin(), deps.end());
+    send_proto(p, (1u << n) - 1u, A_MCOLLECT, pay);
+    drain_and_route(p);
+  }
+
+  void h_mcollect(int p, int src, const std::vector<int32_t>& pl) {
+    int32_t dot = pl[0];
+    uint32_t qmask = uint32_t(pl[1]);
+    std::set<int32_t> rdeps(pl.begin() + 2, pl.end());
+    bool live = gc_live(p, dot);
+    PDot& info = dots[p][dot];
+    bool is_start = live && info.status == ST_START;
+    bool in_q = (qmask >> p) & 1u;
+    bool from_self = src == p;
+    bool q_en = is_start && in_q;
+    int32_t slot = dot_proc(dot) * W + (dot_seq(dot) - 1) % W;
+    std::set<int32_t> deps;
+    if (q_en && !from_self)
+      deps = add_cmd(p, dot, cmd_tab[slot], rdeps);
+    else
+      deps = rdeps;
+    int qsz = __builtin_popcount(qmask);
+    if (!self_ack()) qsz -= 1;
+    if (is_start) info.status = in_q ? ST_COLLECT : ST_PAYLOAD;
+    if (q_en) {
+      info.qsize = qsz;
+      if (info.acc_abal == 0) info.acc_deps = deps;
+    }
+    bool ack_en = self_ack() ? q_en : (q_en && !from_self);
+    if (ack_en) {
+      std::vector<int32_t> pay = {dot};
+      pay.insert(pay.end(), deps.begin(), deps.end());
+      send_proto(p, 1u << src, A_MCOLLECTACK, pay);
+    }
+    if (is_start && !in_q && info.bufc_valid) {
+      info.bufc_valid = false;
+      commit(p, dot, info.bufc_deps);
+    }
+  }
+
+  void h_mcollectack(int p, int src, const std::vector<int32_t>& pl) {
+    (void)src;
+    int32_t dot = pl[0];
+    bool live = gc_live(p, dot);
+    PDot& info = dots[p][dot];
+    bool collect = live && info.status == ST_COLLECT;
+    if (!collect) return;
+    info.qd_count++;
+    for (size_t i = 1; i < pl.size(); i++) info.qd[pl[i]]++;
+    if (info.qd_count != info.qsize) return;
+    int threshold = self_ack() ? info.qsize - n / 2 : info.qsize;
+    bool thr_ok = true;
+    std::set<int32_t> uni;
+    for (auto& [d, c] : info.qd) {
+      uni.insert(d);
+      if (c < threshold) thr_ok = false;
+    }
+    std::vector<int32_t> pay = {dot};
+    if (thr_ok) {
+      fast_cnt[p]++;
+      pay.insert(pay.end(), uni.begin(), uni.end());
+      send_proto(p, (1u << n) - 1u, A_MCOMMIT, pay);
+    } else {
+      slow_cnt[p]++;
+      info.prop_bal = p + 1;  // skip_prepare, ballot = 1-based own id
+      info.prop_acks = 0;
+      info.prop_deps = uni;
+      pay.push_back(p + 1);
+      pay.insert(pay.end(), uni.begin(), uni.end());
+      send_proto(p, uint32_t(wq_mask[p]), A_MCONSENSUS, pay);
+    }
+  }
+
+  void h_mcommit(int p, int src, const std::vector<int32_t>& pl) {
+    (void)src;
+    int32_t dot = pl[0];
+    std::set<int32_t> deps(pl.begin() + 1, pl.end());
+    bool live = gc_live(p, dot);
+    PDot& info = dots[p][dot];
+    if (live && info.status == ST_START) {
+      info.bufc_valid = true;
+      info.bufc_deps = deps;
+    } else if (live &&
+               (info.status == ST_PAYLOAD || info.status == ST_COLLECT)) {
+      commit(p, dot, deps);
+    }
+  }
+
+  void h_mconsensus(int p, int src, const std::vector<int32_t>& pl) {
+    int32_t dot = pl[0], ballot = pl[1];
+    std::set<int32_t> deps(pl.begin() + 2, pl.end());
+    bool live = gc_live(p, dot);
+    PDot& info = dots[p][dot];
+    bool chosen = live && info.status == ST_COMMIT;
+    bool accepted = ballot >= info.acc_bal;
+    if (live && !chosen && accepted) {
+      info.acc_bal = ballot;
+      info.acc_abal = ballot;
+      info.acc_deps = deps;
+    }
+    accepted = accepted && live;
+    if (chosen) {
+      std::vector<int32_t> pay = {dot};
+      pay.insert(pay.end(), info.acc_deps.begin(), info.acc_deps.end());
+      send_proto(p, 1u << src, A_MCOMMIT, pay);
+    } else if (accepted) {
+      send_proto(p, 1u << src, A_MCONSENSUSACK, {dot, ballot});
+    }
+  }
+
+  void h_mconsensusack(int p, int src, const std::vector<int32_t>& pl) {
+    int32_t dot = pl[0], ballot = pl[1];
+    bool live = gc_live(p, dot);
+    if (!live) return;
+    PDot& info = dots[p][dot];
+    bool not_committed = info.status != ST_COMMIT;
+    bool match = info.prop_bal == ballot;
+    bool fresh = match && !((info.prop_acks >> src) & 1u);
+    bool chosen = false;
+    if (fresh) {
+      info.prop_acks |= 1u << src;
+      chosen = __builtin_popcount(info.prop_acks) == wq_size;
+    }
+    if (chosen && not_committed) {
+      std::vector<int32_t> pay = {dot};
+      pay.insert(pay.end(), info.prop_deps.begin(), info.prop_deps.end());
+      send_proto(p, (1u << n) - 1u, A_MCOMMIT, pay);
+    }
+  }
+
+  void handle_proto(const Msg& ev) {
+    int p = ev.dst, src = ev.src;
+    switch (ev.kind - KIND_PROTO_BASE) {
+      case A_MCOLLECT: h_mcollect(p, src, ev.payload); break;
+      case A_MCOLLECTACK: h_mcollectack(p, src, ev.payload); break;
+      case A_MCOMMIT: h_mcommit(p, src, ev.payload); break;
+      case A_MCONSENSUS: h_mconsensus(p, src, ev.payload); break;
+      case A_MCONSENSUSACK: h_mconsensusack(p, src, ev.payload); break;
+      case A_MGC: handle_mgc(p, src, ev.payload); break;
+    }
+    drain_and_route(p);
+  }
+
+  void handle_to_client(const Msg& ev) {
+    int32_t c = ev.payload[0];
+    lat_sum[c] += now - c_start[c];
+    lat_cnt[c]++;
+    bool more = c_issued[c] < cmds;
+    if (more) {
+      int32_t i = c_issued[c];  // 0-based workload index of the next command
+      std::vector<int32_t> pay = {c, i + 1, wl_ro[size_t(c) * cmds + i]};
+      for (int k = 0; k < kpc; k++)
+        pay.push_back(wl_keys[(size_t(c) * cmds + i) * kpc + k]);
+      cand_sub(dist_cp[c], c, client_proc[c], std::move(pay));
+      c_issued[c]++;
+      c_start[c] = now;
+    } else if (!c_done[c]) {
+      c_done[c] = true;
+      clients_done++;
+    }
+  }
+
+  // ------------------------------------------------------------------
+  // instant-batched loop (engine/lockstep.py body/_msg_subrounds)
+  // ------------------------------------------------------------------
+  bool submit_blocked(const Msg& m) const {
+    return m.kind == KIND_SUBMIT && !can_alloc(m.dst);
+  }
+
+  void compact_pool() {
+    if (pool.size() < 64) return;
+    size_t dead = 0;
+    for (auto& m : pool)
+      if (!m.alive) dead++;
+    if (dead * 2 < pool.size()) return;
+    std::vector<Msg> live;
+    live.reserve(pool.size() - dead);
+    for (auto& m : pool)
+      if (m.alive) live.push_back(std::move(m));
+    pool = std::move(live);
+  }
+
+  void msg_subrounds() {
+    for (;;) {
+      if (step >= max_steps) break;
+      // per destination, the earliest-sequence deliverable message
+      std::vector<int> sel_p(n, -1), sel_c(C, -1);
+      bool any = false;
+      for (size_t i = 0; i < pool.size(); i++) {
+        const Msg& m = pool[i];
+        if (!m.alive || m.time > now) continue;
+        if (m.kind == KIND_SUBMIT || m.kind >= KIND_PROTO_BASE) {
+          if (submit_blocked(m)) continue;
+          int p = m.dst;
+          if (sel_p[p] < 0 || m.seq < pool[sel_p[p]].seq) sel_p[p] = int(i);
+          any = true;
+        } else if (m.kind == KIND_TO_CLIENT) {
+          int c = m.dst;
+          if (sel_c[c] < 0 || m.seq < pool[sel_c[c]].seq) sel_c[c] = int(i);
+          any = true;
+        }
+      }
+      if (!any) break;
+      for (int p = 0; p < n; p++)
+        if (sel_p[p] >= 0) {
+          pool[sel_p[p]].alive = false;
+          step++;
+        }
+      for (int c = 0; c < C; c++)
+        if (sel_c[c] >= 0) {
+          pool[sel_c[c]].alive = false;
+          step++;
+        }
+      // process handlers (submit pre-phase is inside handle_submit; the
+      // engine registers all submits before running handlers, which is
+      // equivalent because handlers only read their own dot's command)
+      for (int p = 0; p < n; p++) {
+        if (sel_p[p] < 0) continue;
+        const Msg& m = pool[sel_p[p]];
+        if (m.kind == KIND_SUBMIT)
+          handle_submit(m);
+        else
+          handle_proto(m);
+      }
+      // client handlers
+      for (int c = 0; c < C; c++)
+        if (sel_c[c] >= 0) handle_to_client(pool[sel_c[c]]);
+      flush_cands();
+      compact_pool();
+    }
+  }
+
+  void fire_periodic() {
+    // slots in engine order: 0 = protocol GC, 1 = executed notification,
+    // 2 = executor cleanup; all due processes fire per slot, candidates
+    // are sequenced after the whole batch (engine _fire_periodic)
+    const int64_t intervals[3] = {int64_t(gc_ms), int64_t(executed_ms),
+                                  int64_t(cleanup_ms)};
+    for (int k = 0; k < 3; k++) {
+      std::vector<int> due;
+      for (int p = 0; p < n; p++)
+        if (per_next[p][k] <= now) {
+          per_next[p][k] += intervals[k];
+          due.push_back(p);
+          step++;
+        }
+      for (int p : due) {
+        if (k == 0) {
+          std::vector<int32_t> pay(2 * n);
+          for (int a = 0; a < n; a++) {
+            pay[a] = report_row(p, a);
+            pay[n + a] = stable_wm[p][a];
+          }
+          send_proto(p, ((1u << n) - 1u) & ~(1u << p), A_MGC, pay);
+        } else if (k == 1) {
+          // Executor::executed -> Protocol::handle_executed -> gc_note_exec
+          for (int a = 0; a < n; a++) {
+            int64_t old = gc_exec_fr[p][a];
+            gc_exec_fr[p][a] =
+                old == INF_TIME ? ex_frontier[p][a]
+                                : std::max(old, int64_t(ex_frontier[p][a]));
+          }
+        } else {
+          drain_and_route(p);
+        }
+      }
+    }
+    flush_cands();
+  }
+
+  void run() {
+    init();
+    while (!(all_done && now > final_time) && step < max_steps &&
+           now < INF_TIME) {
+      int64_t t_pool = INF_TIME;
+      for (auto& m : pool)
+        if (m.alive && !submit_blocked(m)) t_pool = std::min(t_pool, m.time);
+      int64_t t_per = INF_TIME;
+      for (auto& row : per_next)
+        for (int64_t t : row) t_per = std::min(t_per, t);
+      now = std::min(t_pool, t_per);
+      msg_subrounds();
+      fire_periodic();
+      msg_subrounds();
+      bool was_done = all_done;
+      all_done = clients_done >= C;
+      if (all_done && !was_done) final_time = now + extra_ms;
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// iparams layout (int32): [n, C, kpc, max_seq, commands_per_client, variant,
+// wq_size, max_res, extra_ms, gc_interval_ms, executed_ms, cleanup_ms,
+// reorder_hash, salt_bits, key_space]; variant: 0 = atlas/janus, 1 = epaxos.
+int sim_atlas(const int32_t* iparams, long long max_steps,
+              const int32_t* dist_pp, const int32_t* dist_pc,
+              const int32_t* dist_cp, const int32_t* client_proc,
+              const int32_t* fq_mask, const int32_t* wq_mask,
+              const int32_t* wl_keys, const int32_t* wl_ro,
+              long long* lat_sum, int32_t* lat_cnt, int32_t* commit_count,
+              int32_t* stable_count, int32_t* fast_count, int32_t* slow_count,
+              int32_t* order_hash_out, int32_t* order_cnt_out,
+              int32_t* c_vals_out, long long* out_steps) {
+  AtlasSim s;
+  s.n = iparams[0];
+  s.C = iparams[1];
+  s.kpc = iparams[2];
+  s.W = iparams[3];
+  s.cmds = iparams[4];
+  s.variant = iparams[5];
+  s.wq_size = iparams[6];
+  s.max_res = iparams[7];
+  s.extra_ms = iparams[8];
+  s.gc_ms = iparams[9];
+  s.executed_ms = iparams[10];
+  s.cleanup_ms = iparams[11];
+  s.reorder_hash = iparams[12] != 0;
+  s.salt = uint32_t(iparams[13]);
+  s.key_space = iparams[14];
+  s.max_steps = max_steps;
+  if (s.n < 1 || s.n > 30 || s.C < 1 || s.kpc < 1 || s.key_space < 1) return 1;
+  s.dist_pp = dist_pp;
+  s.dist_pc = dist_pc;
+  s.dist_cp = dist_cp;
+  s.client_proc = client_proc;
+  s.fq_mask = fq_mask;
+  s.wq_mask = wq_mask;
+  s.wl_keys = wl_keys;
+  s.wl_ro = wl_ro;
+
+  s.run();
+
+  for (int c = 0; c < s.C; c++) {
+    lat_sum[c] = s.lat_sum[c];
+    lat_cnt[c] = s.lat_cnt[c];
+    for (int k = 0; k < s.kpc; k++) c_vals_out[c * s.kpc + k] = s.c_vals[c][k];
+  }
+  for (int p = 0; p < s.n; p++) {
+    commit_count[p] = s.commit_cnt[p];
+    stable_count[p] = s.stable_cnt[p];
+    fast_count[p] = s.fast_cnt[p];
+    slow_count[p] = s.slow_cnt[p];
+    for (int k = 0; k < s.key_space; k++) {
+      order_hash_out[p * s.key_space + k] = int32_t(s.order_hash[p][k]);
+      order_cnt_out[p * s.key_space + k] = s.order_cnt[p][k];
+    }
+  }
+  *out_steps = s.step;
+  return 0;
+}
+
+}  // extern "C"

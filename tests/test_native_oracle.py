@@ -162,6 +162,149 @@ FPAXOS_CASES = [
 ]
 
 
+def run_both_atlas(variant, n, f, pregions, cregions, cpr, cmds, window,
+                   conflict, read_only_pct, reorder_hash, seed=0):
+    """Atlas/EPaxos engine vs the native dependency-graph oracle
+    (native/atlas_oracle.cpp): the hardest kernels — per-key dep collection,
+    quorum fast-path checks, synod slow path, the graph executor's
+    SCC-ready ordering and windowed GC compaction — cross-checked against an
+    independent map-based C++ implementation, optionally under the
+    deterministic hash-reorder mode."""
+    import jax.numpy as jnp
+
+    from fantoch_tpu.core import workload as workload_mod
+    from fantoch_tpu.engine.lockstep import reorder_salt
+    from fantoch_tpu.protocols import atlas as atlas_proto
+    from fantoch_tpu.protocols import epaxos as epaxos_proto
+    from fantoch_tpu.utils.native import sim_atlas_oracle
+
+    planet = Planet.new()
+    config = Config(n=n, f=f, gc_interval_ms=100)
+    workload = Workload(
+        shard_count=1,
+        key_gen=KeyGen.conflict_pool(conflict_rate=conflict, pool_size=2),
+        keys_per_command=1,
+        commands_per_client=cmds,
+        read_only_percentage=read_only_pct,
+    )
+    pdef = (
+        atlas_proto.make_protocol(n, 1)
+        if variant == 0
+        else epaxos_proto.make_protocol(n, 1)
+    )
+    C = len(cregions) * cpr
+    spec = setup.build_spec(
+        config, workload, pdef, n_clients=C, n_client_groups=len(cregions),
+        extra_ms=1000, max_steps=5_000_000, max_seq=window,
+        reorder_hash=reorder_hash,
+        # reorder multiplies WAN delays by up to 10x; keep slow-path
+        # latencies inside the histogram range
+        hist_buckets=8192 if reorder_hash else 2048,
+    )
+    placement = setup.Placement(pregions, cregions, cpr)
+    env = setup.build_env(spec, config, planet, placement, workload, pdef,
+                          seed=seed)
+
+    st = jax.jit(lockstep.make_run(spec, pdef, workload))(env)
+    st = jax.tree_util.tree_map(np.asarray, st)
+    summary.check_sim_health(st)
+    engine = {
+        "lat_sum": st.lat_sum.astype(np.int64),
+        "lat_cnt": st.lat_cnt,
+        "commit_count": np.asarray(st.proto.commit_count),
+        "stable_count": np.asarray(st.proto.gc.stable_count),
+        "fast_count": np.asarray(st.proto.fast_count),
+        "slow_count": np.asarray(st.proto.slow_count),
+        "order_hash": np.asarray(st.exec.order_hash),
+        "order_cnt": np.asarray(st.exec.order_cnt),
+        "c_vals": np.asarray(st.c_vals)[:, 0, :],
+        "steps": int(st.step),
+    }
+
+    # precompute the workload stream with the engine's own sampler: the
+    # oracle receives keys/read-only flags as plain arrays
+    consts = workload_mod.WorkloadConsts.build(workload)
+    key = jax.random.wrap_key_data(jnp.asarray(env.seed))
+    cids = jnp.repeat(jnp.arange(C, dtype=jnp.int32), cmds)
+    idxs = jnp.tile(jnp.arange(cmds, dtype=jnp.int32), C)
+    keys, ro = jax.vmap(
+        lambda c, i: workload_mod.sample_command_keys(
+            consts, key, c, i, env.conflict_rate, env.read_only_pct
+        )
+    )(cids, idxs)
+    keys = np.asarray(keys).reshape(C, cmds, 1)
+    ro = np.asarray(ro).reshape(C, cmds).astype(np.int32)
+
+    oracle = sim_atlas_oracle(
+        n=n,
+        n_clients=C,
+        keys_per_command=1,
+        max_seq=spec.max_seq,
+        commands_per_client=cmds,
+        variant=variant,
+        wq_size=int(env.wq_size),
+        max_res=spec.max_res,
+        extra_ms=spec.extra_ms,
+        gc_interval_ms=100,
+        executed_ms=spec.executed_ms,
+        cleanup_ms=spec.cleanup_ms,
+        reorder_hash=reorder_hash,
+        salt=int(np.asarray(reorder_salt(env))),
+        key_space=spec.key_space,
+        max_steps=spec.max_steps,
+        dist_pp=env.dist_pp,
+        dist_pc=env.dist_pc,
+        dist_cp=env.dist_cp[:, 0],
+        client_proc=env.client_proc[:, 0],
+        fq_mask=env.fq_mask,
+        wq_mask=env.wq_mask,
+        keys=keys,
+        read_only=ro,
+    )
+    return engine, oracle
+
+
+ATLAS_CASES = [
+    # (variant, n, f, pregions, cregions, cpr, cmds, window, conflict, ro%, reorder)
+    (0, 3, 1, ["asia-east1", "us-central1", "us-west1"],
+     ["us-west1", "us-west2"], 1, 20, 8, 100, 0, False),
+    (0, 3, 1, ["asia-east1", "us-central1", "us-west1"],
+     ["us-west1", "us-west2"], 2, 15, 6, 100, 20, True),
+    (0, 5, 2, ["asia-east1", "us-central1", "us-west1", "europe-west2",
+               "europe-west3"], ["us-west1", "europe-west2"], 2, 10, 8, 100,
+     0, True),
+    (1, 3, 1, ["asia-east1", "us-central1", "us-west1"],
+     ["us-west1", "us-west2"], 1, 15, 8, 100, 0, True),
+]
+
+
+@pytest.mark.parametrize(
+    "variant,n,f,pregions,cregions,cpr,cmds,window,conflict,ro,reorder",
+    ATLAS_CASES,
+)
+def test_engine_matches_native_oracle_atlas(variant, n, f, pregions, cregions,
+                                            cpr, cmds, window, conflict, ro,
+                                            reorder):
+    engine, oracle = run_both_atlas(
+        variant, n, f, pregions, cregions, cpr, cmds, window, conflict, ro,
+        reorder,
+    )
+    np.testing.assert_array_equal(engine["lat_cnt"], oracle["lat_cnt"])
+    np.testing.assert_array_equal(engine["lat_sum"], oracle["lat_sum"])
+    np.testing.assert_array_equal(engine["commit_count"], oracle["commit_count"])
+    np.testing.assert_array_equal(engine["stable_count"], oracle["stable_count"])
+    np.testing.assert_array_equal(engine["fast_count"], oracle["fast_count"])
+    np.testing.assert_array_equal(engine["slow_count"], oracle["slow_count"])
+    # the per-(process, key) rolling execution-order hashes: equality means
+    # the device closure kernel ordered every conflicting command exactly
+    # like the oracle's reachability-based implementation
+    np.testing.assert_array_equal(engine["order_hash"], oracle["order_hash"])
+    np.testing.assert_array_equal(engine["order_cnt"], oracle["order_cnt"])
+    # returned KV values aggregated into each client's final CommandResult
+    np.testing.assert_array_equal(engine["c_vals"], oracle["c_vals"])
+    assert abs(engine["steps"] - oracle["steps"]) <= 16
+
+
 @pytest.mark.parametrize("n,f,leader,pregions,cregions,cpr,cmds", FPAXOS_CASES)
 def test_engine_matches_native_oracle_fpaxos(n, f, leader, pregions, cregions,
                                              cpr, cmds):
