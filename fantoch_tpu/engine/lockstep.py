@@ -163,6 +163,7 @@ class Env(NamedTuple):
 class SimState(NamedTuple):
     now: jnp.ndarray
     step: jnp.ndarray
+    iters: jnp.ndarray  # body iterations (instants x sub-rounds; perf gauge)
     seqno: jnp.ndarray
     dropped: jnp.ndarray
     # message pool
@@ -969,27 +970,57 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         cand = _cat_cands([_expand_outbox(env, ob), replies, subs, ticks])
         return _insert(st, env, cand)
 
-    def _msg_subrounds(env: Env, st: SimState) -> SimState:
-        def cond(s):
-            # the step bound also backstops a (buggy) zero-delay message
-            # ping-pong inside one instant, like the outer loop's max_steps
-            return _eff_deliv(s).any() & (s.step < spec.max_steps)
-
-        return jax.lax.while_loop(
-            cond, functools.partial(_delivery_round, env), st
-        )
-
     # ------------------------------------------------------------------
     # periodic timers
     # ------------------------------------------------------------------
 
+    def _slot_fns(now):
+        """The NPER periodic-slot handlers as row-local functions
+        `(ctx, proto1, exec1) -> (proto1, exec1, Outbox, ResOut)`."""
+        fns = []
+        for k in range(NPER):
+            if k < len(spec.proto_periodic_kinds):
+                proto_kind = spec.proto_periodic_kinds[k]
+
+                def fn(ctx, proto1, exec1, proto_kind=proto_kind):
+                    pst, ob = pdef.periodic(
+                        ctx, proto1, jnp.int32(0), proto_kind, now
+                    )
+                    return pst, exec1, ob, _empty_res()
+            elif exec_notify_slot is not None and k == exec_notify_slot:
+
+                def fn(ctx, proto1, exec1):
+                    est, info = exdef.executed(ctx, exec1, jnp.int32(0))
+                    pst, ob = pdef.handle_executed(
+                        ctx, proto1, jnp.int32(0), info, now
+                    )
+                    return pst, est, ob, _empty_res()
+            elif monitor_slot is not None and k == monitor_slot:
+
+                def fn(ctx, proto1, exec1):
+                    est = exdef.monitor(ctx, exec1, jnp.int32(0))
+                    return proto1, est, _empty_ob(), _empty_res()
+            else:  # executor cleanup tick
+
+                def fn(ctx, proto1, exec1):
+                    est, res = exdef.drain(ctx, exec1, jnp.int32(0))
+                    return proto1, est, _empty_ob(), res
+
+            fns.append(fn)
+        return fns
+
     def _fire_periodic(env: Env, st: SimState) -> SimState:
+        """Fire ALL due periodic slots, slot-major (slot k for every due
+        process, then slot k+1, ...) — the canonical same-instant order the
+        native oracle and the distributed runner reproduce: deliverable
+        messages drained first, then every due timer, then cascades."""
         cmds = CmdView(st.cmd_client, st.cmd_rifl, st.cmd_keys, st.cmd_ro)
         blocks: List[Candidates] = []
+        fns = _slot_fns(st.now)
 
         def periodic_rows(st, due, fn):
-            """Apply `fn(ctx, row_states...) -> (new rows..., outbox)` per
-            process with due-masking; returns new state + outbox."""
+            """Apply `fn(ctx, proto1, exec1) -> (proto1, exec1, Outbox,
+            ResOut)` per process with due-masking."""
 
             if ROW_LOOP:
                 prots, execs, obs, ress = [], [], [], []
@@ -1057,34 +1088,7 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
                 ),
                 step=st.step + due.sum(),
             )
-            if k < len(spec.proto_periodic_kinds):
-                proto_kind = spec.proto_periodic_kinds[k]
-
-                def fn(ctx, proto1, exec1, proto_kind=proto_kind):
-                    pst, ob = pdef.periodic(
-                        ctx, proto1, jnp.int32(0), proto_kind, st.now
-                    )
-                    return pst, exec1, ob, _empty_res()
-            elif exec_notify_slot is not None and k == exec_notify_slot:
-
-                def fn(ctx, proto1, exec1):
-                    est, info = exdef.executed(ctx, exec1, jnp.int32(0))
-                    pst, ob = pdef.handle_executed(
-                        ctx, proto1, jnp.int32(0), info, st.now
-                    )
-                    return pst, est, ob, _empty_res()
-            elif monitor_slot is not None and k == monitor_slot:
-
-                def fn(ctx, proto1, exec1):
-                    est = exdef.monitor(ctx, exec1, jnp.int32(0))
-                    return proto1, est, _empty_ob(), _empty_res()
-            else:  # executor cleanup tick
-
-                def fn(ctx, proto1, exec1):
-                    est, res = exdef.drain(ctx, exec1, jnp.int32(0))
-                    return proto1, est, _empty_ob(), res
-
-            proto, exc, ob, res = periodic_rows(st, due, fn)
+            proto, exc, ob, res = periodic_rows(st, due, fns[k])
             st = st._replace(proto=proto, exec=exc)
             blocks.append(_expand_outbox(env, ob))
             st, replies = _route_results(st, env, res)
@@ -1143,6 +1147,7 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         st = SimState(
             now=jnp.int32(0),
             step=jnp.int32(0),
+            iters=jnp.int32(0),
             seqno=jnp.int32(C),
             dropped=jnp.int32(0),
             m_valid=jnp.arange(S) < C,
@@ -1220,37 +1225,66 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             & (st.now < INF_TIME)
         )
 
-    def body(env: Env, st: SimState) -> SimState:
-        # window-blocked submits do not pin the clock: time advances past
-        # them and they deliver at the first instant GC frees their slot
-        times = jnp.where(_eff_deliv(st._replace(now=INF_TIME)), st.m_time, INF_TIME)
-        t_pool = times.min()
-        t_per = st.per_next.min()
-        now = jnp.minimum(t_pool, t_per)
-        st = st._replace(now=now)
-        # pool messages first (the reference pops pool actions before
-        # periodic events on time ties), then timers, then cascades
-        st = _msg_subrounds(env, st)
-        if ROW_LOOP:
-            # scalar predicate -> real branch: skip the timer machinery on
-            # instants with nothing due (most of them)
-            st = jax.lax.cond(
-                st.per_next.min() <= st.now,
-                functools.partial(_fire_periodic, env),
-                lambda s: s,
-                st,
-            )
-        else:
-            st = _fire_periodic(env, st)
-        st = _msg_subrounds(env, st)
+    def _end_instant(env: Env, st: SimState) -> SimState:
+        """Nothing deliverable and no timer due at `now`: close the instant
+        (done-state updates) and advance the clock to the next event.
+        Window-blocked submits do not pin the clock: time advances past them
+        and they deliver at the first instant GC frees their slot."""
         clients_done = st.c_done.sum()
         all_done = clients_done >= C
-        return st._replace(
+        st = st._replace(
             clients_done=clients_done,
             final_time=jnp.where(
                 all_done & ~st.all_done, st.now + spec.extra_ms, st.final_time
             ),
             all_done=all_done,
+        )
+        times = jnp.where(
+            _eff_deliv(st._replace(now=INF_TIME)), st.m_time, INF_TIME
+        )
+        return st._replace(now=jnp.minimum(times.min(), st.per_next.min()))
+
+    def body(env: Env, st: SimState) -> SimState:
+        """One flat loop trip: a delivery sub-round if anything is
+        deliverable at `now`, else fire the due timers, else end the instant.
+
+        A single-level loop on purpose: nesting the sub-round loop inside a
+        per-instant loop costs, under `vmap`, the sum over instants of the
+        *max* sub-round count across the batch — desynchronized configs
+        (different seeds/conflicts) make that far exceed any single config's
+        own trip count. Flat, every trip advances every active config by one
+        unit of its own schedule, so the device trip count is just the max
+        of per-config totals. The per-instant ORDER is unchanged: messages
+        drain to quiescence first (the reference pops pool actions before
+        periodic events on time ties), then due timers fire, then their
+        cascades drain, then time advances.
+        """
+        st = st._replace(iters=st.iters + 1)
+        any_deliv = _eff_deliv(st).any()
+        any_due = (st.per_next <= st.now).any()
+
+        def advance(st):
+            return jax.lax.cond(
+                (st.per_next <= st.now).any(),
+                functools.partial(_fire_periodic, env),
+                functools.partial(_end_instant, env),
+                st,
+            )
+
+        if ROW_LOOP:
+            return jax.lax.cond(
+                any_deliv,
+                functools.partial(_delivery_round, env),
+                advance,
+                st,
+            )
+        # vmapped TPU path: lax.cond with a batched predicate lowers to
+        # computing both sides; selecting explicitly keeps that obvious
+        st_d = _delivery_round(env, st)
+        st_p = _fire_periodic(env, st)
+        st_e = _end_instant(env, st)
+        return _tree_select(
+            any_deliv, st_d, _tree_select(any_due, st_p, st_e)
         )
 
     def run(env: Env) -> SimState:

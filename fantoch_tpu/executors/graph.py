@@ -57,6 +57,19 @@ from .ready import ReadyRing, ready_capacity, ready_drain, ready_init, ready_pus
 
 ORDER_HASH_MULT = jnp.int32(0x01000193)
 
+
+def _mult_powers(count: int):
+    """uint32 powers ORDER_HASH_MULT^i for i in [0, count) (host constant)."""
+    import numpy as np
+
+    out = np.empty(count, np.uint32)
+    x = np.uint32(1)
+    with np.errstate(over="ignore"):
+        for i in range(count):
+            out[i] = x
+            x = np.uint32(x * np.uint32(0x01000193))
+    return out
+
 # missing-dep request slots surfaced per executed-notification tick
 MAX_REQS = 8
 
@@ -189,59 +202,111 @@ def make_executor(
             ].add(rep.astype(jnp.int32))
         )
 
-        def cond(carry):
-            e, u = carry
-            return u.any()
-
-        def body(carry):
-            e, u = carry
-            r = jnp.where(u, rank, jnp.int32(2**30))
-            rmin = r.min()
-            # in-SCC tie-break by DOT (coordinator, sequence) like the
-            # reference (`tarjan.rs:14-15`) — ring slots can wrap, so slot
-            # order is not dot order; the per-slot generation is
-            d = jnp.argmin(
-                jnp.where(u & (r == rmin), e.vdot[p], jnp.int32(2**30))
-            ).astype(jnp.int32)
-            client = ctx.cmds.client[d]
-            rifl = ctx.cmds.rifl_seq[d]
-            wr = ~ctx.cmds.read_only[d]  # Gets never mutate the store
-            kvs, oh, oc, ready = e.kvs, e.order_hash, e.order_cnt, e.ready
-            for k in range(KPC):
-                key = ctx.cmds.keys[d, k]
-                # partial replication: apply and answer only this shard's
-                # keys; remote-fetched vertices execute as ordering-only
-                # no-ops (the dep's own shard serves its client results)
-                owned = (
-                    jnp.bool_(True)
-                    if shards == 1
-                    else key_shard(key, shards) == ctx.env.shard_of[ctx.pid]
-                )
-                old = kvs[p, key]
-                kvs = kvs.at[p, key].set(
-                    jnp.where(owned & wr, writer_id(client, rifl), old)
-                )
-                oh = oh.at[p, key].set(
-                    jnp.where(owned, oh[p, key] * ORDER_HASH_MULT + (d + 1), oh[p, key])
-                )
-                oc = oc.at[p, key].add(owned.astype(jnp.int32))
-                ready = ready_push(ready, p, client, rifl, enable=owned,
-                                   kslot=k, value=old)
-            e = e._replace(
-                kvs=kvs,
-                order_hash=oh,
-                order_cnt=oc,
-                ready=ready,
-                executed=e.executed.at[p, d].set(True),
-                executed_count=e.executed_count.at[p].add(1),
-                # ExecutionDelay: vertex creation -> execution (graph/mod.rs:518)
-                delay_hist=hist_add(
-                    e.delay_hist, p, now - e.recv_ms[p, d], True
-                ),
+        # --- execute U in one vectorized pass, in ascending (rank, dot)
+        # order — in-SCC ties break by DOT like the reference
+        # (`tarjan.rs:14-15`). The execution order, per-key rolling hashes,
+        # KVS read/write interleaving and ready-ring entry order are
+        # bit-identical to executing one command per step (the discipline the
+        # native oracle implements sequentially; tests/test_native_oracle.py
+        # pins the equality), but the whole batch costs ~30 wide ops instead
+        # of a `lax.while_loop` whose trip count is the chain length.
+        ucount = U.sum()
+        # lexsort by (rank, dot) without int64: stable-sort by dot, then
+        # stable-sort that order by rank (non-U slots sink to the end)
+        big = jnp.int32(2**30)
+        perm_d = jnp.argsort(
+            jnp.where(U, est.vdot[p], big), stable=True
+        ).astype(jnp.int32)
+        perm = perm_d[
+        jnp.argsort(
+            jnp.where(U[perm_d], rank[perm_d], big), stable=True
+        )
+        ].astype(jnp.int32)  # [DOTS] slot order
+        E = DOTS * KPC
+        e_iota = jnp.arange(E, dtype=jnp.int32)
+        r_of_e = e_iota // KPC
+        k_of_e = e_iota % KPC
+        s_of_e = perm[r_of_e]  # [E] ring slot per entry
+        valid_e = r_of_e < ucount
+        client_e = ctx.cmds.client[s_of_e]
+        rifl_e = ctx.cmds.rifl_seq[s_of_e]
+        wr_e = ~ctx.cmds.read_only[s_of_e]  # Gets never mutate the store
+        key_e = ctx.cmds.keys[s_of_e, k_of_e]
+        # partial replication: apply and answer only this shard's keys;
+        # remote-fetched vertices execute as ordering-only no-ops (the dep's
+        # own shard serves its client results)
+        if shards == 1:
+            owned_e = valid_e
+        else:
+            owned_e = valid_e & (
+                key_shard(key_e, shards) == ctx.env.shard_of[ctx.pid]
             )
-            return e, u.at[d].set(False)
-
-        est, _ = jax.lax.while_loop(cond, body, (est, U))
+        # Per-key aggregates via [E, E] pair matrices + O(E) scatters — never
+        # a tensor over the key space (zipf key spaces reach ~1M keys)
+        K = est.kvs.shape[1]
+        before = e_iota[:, None] > e_iota[None, :]  # [E, E'] e' earlier
+        after = e_iota[:, None] < e_iota[None, :]
+        samekey = key_e[:, None] == key_e[None, :]
+        own_col = owned_e[None, :]
+        c_e = (before & samekey & own_col).sum(axis=1)  # occurrence index
+        m_of_e = (samekey & own_col).sum(axis=1)  # batch entries on e's key
+        scat = jnp.where(owned_e, key_e, K)  # K = dropped
+        m_k = jnp.zeros((K,), jnp.int32).at[scat].add(1, mode="drop")
+        # rolling hash: oh'_k = oh_k * M^m_k + sum_e (slot_e+1) * M^(m_k-1-c_e)
+        # (uint32 wraps = the int32 state's two's-complement wraps)
+        pow_tab = jnp.asarray(_mult_powers(E + 1), jnp.uint32)
+        term_e = (s_of_e + 1).astype(jnp.uint32) * pow_tab[
+            jnp.clip(m_of_e - 1 - c_e, 0, E)
+        ]
+        add_k = jnp.zeros((K,), jnp.uint32).at[scat].add(term_e, mode="drop")
+        oh_row = (
+            est.order_hash[p].astype(jnp.uint32) * pow_tab[jnp.clip(m_k, 0, E)]
+            + add_k
+        ).astype(jnp.int32)
+        # KVS: last write per key wins (scatter only each key's final write);
+        # each entry's returned value is the previous same-key write in entry
+        # order, or the pre-batch store value
+        wid_e = writer_id(client_e, rifl_e)  # [E]
+        write_e = owned_e & wr_e
+        last_w = write_e & ~(after & samekey & write_e[None, :]).any(axis=1)
+        kvs_row = est.kvs[p].at[jnp.where(last_w, key_e, K)].set(
+            wid_e, mode="drop"
+        )
+        prevmat = before & samekey & write_e[None, :]  # prior same-key writes
+        pidx = jnp.where(prevmat, e_iota[None, :], -1).max(axis=1)  # [E]
+        old_e = jnp.where(
+            pidx >= 0, wid_e[jnp.clip(pidx, 0, E - 1)], est.kvs[p][key_e]
+        )
+        # ready ring: entries append in execution order (ring indices are
+        # the exclusive running count of owned entries)
+        ring = est.ready
+        cap = ring.client.shape[1]
+        rr = jnp.cumsum(owned_e.astype(jnp.int32)) - owned_e.astype(jnp.int32)
+        room = (ring.push[p] + rr - ring.pop[p]) < cap
+        do_e = owned_e & room
+        ridx = jnp.where(do_e, (ring.push[p] + rr) % cap, cap)  # cap = drop
+        ring = ring._replace(
+            client=ring.client.at[p, ridx].set(client_e, mode="drop"),
+            rifl_seq=ring.rifl_seq.at[p, ridx].set(rifl_e, mode="drop"),
+            kslot=ring.kslot.at[p, ridx].set(k_of_e, mode="drop"),
+            value=ring.value.at[p, ridx].set(old_e, mode="drop"),
+            push=ring.push.at[p].add(do_e.sum()),
+            overflow=ring.overflow.at[p].add((owned_e & ~room).sum()),
+        )
+        # ExecutionDelay: vertex creation -> execution (graph/mod.rs:518)
+        HB = est.delay_hist.shape[1]
+        dclip = jnp.clip(now - est.recv_ms[p], 0, HB - 1)
+        est = est._replace(
+            kvs=est.kvs.at[p].set(kvs_row),
+            order_hash=est.order_hash.at[p].set(oh_row),
+            order_cnt=est.order_cnt.at[p].add(m_k),
+            ready=ring,
+            executed=est.executed.at[p].set(est.executed[p] | U),
+            executed_count=est.executed_count.at[p].add(ucount),
+            delay_hist=est.delay_hist.at[p, jnp.where(U, dclip, HB)].add(
+                1, mode="drop"
+            ),
+        )
 
         # advance the contiguous executed frontier per coordinator (AEClock)
         fr = ids.advance_frontiers(

@@ -112,28 +112,37 @@ def quorumdeps_init(n: int, dots: int, max_deps: int) -> QuorumDepsState:
 
 
 def quorumdeps_add(qd: QuorumDepsState, p, dot, deps, enable):
-    """QuorumDeps::add — count one participant's dep set (already deduped)."""
+    """QuorumDeps::add — count one participant's dep set (already deduped).
+
+    One vectorized pass: present values bump their slot's count, new values
+    fill free slots in incoming order (rank-matched assignment, the dense
+    style of the engine's pool insert) — same result as inserting one value
+    at a time, ~10 wide ops instead of a D-iteration scan loop.
+    """
     enable = jnp.asarray(enable)
-    D = qd.dep.shape[2]
-    row_dep = qd.dep[p, dot]
-    row_cnt = qd.cnt[p, dot]
-    overflow = qd.overflow[p]
-    for j in range(deps.shape[0]):
-        v = deps[j]
-        add = enable & (v > 0)
-        present = row_dep == v
-        hit = present.any()
-        free = row_dep == 0
-        slot = jnp.where(hit, jnp.argmax(present), jnp.argmax(free))
-        ok = add & (hit | free.any())
-        row_dep = row_dep.at[slot].set(jnp.where(ok, v, row_dep[slot]))
-        row_cnt = row_cnt.at[slot].add(jnp.where(ok, 1, 0))
-        overflow = overflow + (add & ~ok).astype(jnp.int32)
+    row_dep = qd.dep[p, dot]  # [D]
+    vvalid = enable & (deps > 0)  # [Din] incoming values (deduped)
+    present = row_dep[None, :] == deps[:, None]  # [Din, D]; <=1 hit per row
+    new = vvalid & ~present.any(axis=1)
+    free = row_dep == 0
+    frank = jnp.cumsum(free) - 1
+    nrank = jnp.cumsum(new) - 1
+    ok_new = new & (nrank < free.sum())
+    assign = ok_new[:, None] & free[None, :] & (
+        nrank[:, None] == frank[None, :]
+    )  # [Din, D]
+    placed = assign.any(axis=0)
+    row_dep = jnp.where(
+        placed, jnp.sum(jnp.where(assign, deps[:, None], 0), axis=0), row_dep
+    )
+    inc = jnp.sum(
+        ((present & vvalid[:, None]) | assign).astype(jnp.int32), axis=0
+    )
     return qd._replace(
         count=qd.count.at[p, dot].add(enable.astype(jnp.int32)),
         dep=qd.dep.at[p, dot].set(row_dep),
-        cnt=qd.cnt.at[p, dot].set(row_cnt),
-        overflow=qd.overflow.at[p].set(overflow),
+        cnt=qd.cnt.at[p, dot].add(inc),
+        overflow=qd.overflow.at[p].add((new & ~ok_new).sum()),
     )
 
 

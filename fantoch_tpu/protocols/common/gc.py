@@ -76,22 +76,26 @@ def gc_init(n: int, dots: int) -> GCTrack:
 
 def gc_commit(gc: GCTrack, p, dot, enable, window: int) -> GCTrack:
     """Record a committed dot (the inlined `MCommitDot` self-forward) and
-    advance the contiguous frontier for the dot's coordinator."""
+    advance the contiguous frontier for the dot's coordinator.
+
+    The frontier advance probes all `window` next ring positions at once
+    (the ring holds at most `window` live sequences) instead of a
+    `lax.while_loop` — a data-dependent trip count costs max-over-batch
+    iterations under `vmap`; the closed form is a few wide ops always.
+    `cdot`'s generation tag keeps a stale (not-yet-recycled) occupant from
+    aliasing as the probed sequence."""
     sl = ids.dot_slot(dot, window)
     cdot = gc.cdot.at[p, sl].set(jnp.where(enable, dot, gc.cdot[p, sl]))
     a = ids.dot_proc(dot)
-
-    def adv_cond(fr):
-        # seq fr+1 lives at ring slot fr % window; the generation tag keeps
-        # a stale (not-yet-recycled) occupant from aliasing as fr+1
-        return (
-            cdot[p, a * window + fr % window] == ids.dot_make(a, fr + 1)
-        ) & (fr < gc.frontier[p, a] + window)
-
-    fr = jax.lax.while_loop(adv_cond, lambda fr: fr + 1, gc.frontier[p, a])
+    fr0 = gc.frontier[p, a]
+    j = jnp.arange(window, dtype=jnp.int32)  # [W]
+    probe = cdot[p, a * window + (fr0 + j) % window] == ids.dot_make(
+        a, fr0 + 1 + j
+    )
+    fr = fr0 + jnp.cumprod(probe.astype(jnp.int32)).sum()
     return gc._replace(
         cdot=cdot,
-        frontier=gc.frontier.at[p, a].set(jnp.where(enable, fr, gc.frontier[p, a])),
+        frontier=gc.frontier.at[p, a].set(jnp.where(enable, fr, fr0)),
     )
 
 
