@@ -51,24 +51,19 @@ import jax.numpy as jnp
 from ..core import ids
 from ..engine.types import ExecutorDef
 from ..ops.closure import transitive_closure
-from ..protocols.common.mhist import hist_add, hist_init
+from ..protocols.common.mhist import hist_init
 from ..protocols.common.sharding import key_shard
-from .ready import ReadyRing, ready_capacity, ready_drain, ready_init, ready_push, writer_id
-
-ORDER_HASH_MULT = jnp.int32(0x01000193)
-
-
-def _mult_powers(count: int):
-    """uint32 powers ORDER_HASH_MULT^i for i in [0, count) (host constant)."""
-    import numpy as np
-
-    out = np.empty(count, np.uint32)
-    x = np.uint32(1)
-    with np.errstate(over="ignore"):
-        for i in range(count):
-            out[i] = x
-            x = np.uint32(x * np.uint32(0x01000193))
-    return out
+from .ready import (
+    ReadyRing,
+    kv_apply_batch,
+    mult_powers,
+    ready_capacity,
+    ready_drain,
+    ready_init,
+    ready_push,
+    ready_push_batch,
+    writer_id,
+)
 
 # missing-dep request slots surfaced per executed-notification tick
 MAX_REQS = 8
@@ -218,9 +213,7 @@ def make_executor(
             jnp.where(U, est.vdot[p], big), stable=True
         ).astype(jnp.int32)
         perm = perm_d[
-        jnp.argsort(
-            jnp.where(U[perm_d], rank[perm_d], big), stable=True
-        )
+            jnp.argsort(jnp.where(U[perm_d], rank[perm_d], big), stable=True)
         ].astype(jnp.int32)  # [DOTS] slot order
         E = DOTS * KPC
         e_iota = jnp.arange(E, dtype=jnp.int32)
@@ -245,7 +238,6 @@ def make_executor(
         # a tensor over the key space (zipf key spaces reach ~1M keys)
         K = est.kvs.shape[1]
         before = e_iota[:, None] > e_iota[None, :]  # [E, E'] e' earlier
-        after = e_iota[:, None] < e_iota[None, :]
         samekey = key_e[:, None] == key_e[None, :]
         own_col = owned_e[None, :]
         c_e = (before & samekey & own_col).sum(axis=1)  # occurrence index
@@ -254,7 +246,7 @@ def make_executor(
         m_k = jnp.zeros((K,), jnp.int32).at[scat].add(1, mode="drop")
         # rolling hash: oh'_k = oh_k * M^m_k + sum_e (slot_e+1) * M^(m_k-1-c_e)
         # (uint32 wraps = the int32 state's two's-complement wraps)
-        pow_tab = jnp.asarray(_mult_powers(E + 1), jnp.uint32)
+        pow_tab = jnp.asarray(mult_powers(E + 1), jnp.uint32)
         term_e = (s_of_e + 1).astype(jnp.uint32) * pow_tab[
             jnp.clip(m_of_e - 1 - c_e, 0, E)
         ]
@@ -263,35 +255,14 @@ def make_executor(
             est.order_hash[p].astype(jnp.uint32) * pow_tab[jnp.clip(m_k, 0, E)]
             + add_k
         ).astype(jnp.int32)
-        # KVS: last write per key wins (scatter only each key's final write);
-        # each entry's returned value is the previous same-key write in entry
-        # order, or the pre-batch store value
+        # KVS last-write-wins + per-entry returned values + ready-ring append
+        # (shared batch helpers, executors/ready.py)
         wid_e = writer_id(client_e, rifl_e)  # [E]
-        write_e = owned_e & wr_e
-        last_w = write_e & ~(after & samekey & write_e[None, :]).any(axis=1)
-        kvs_row = est.kvs[p].at[jnp.where(last_w, key_e, K)].set(
-            wid_e, mode="drop"
+        kvs_row, old_e = kv_apply_batch(
+            est.kvs[p], e_iota, key_e, wid_e, owned_e & wr_e, K
         )
-        prevmat = before & samekey & write_e[None, :]  # prior same-key writes
-        pidx = jnp.where(prevmat, e_iota[None, :], -1).max(axis=1)  # [E]
-        old_e = jnp.where(
-            pidx >= 0, wid_e[jnp.clip(pidx, 0, E - 1)], est.kvs[p][key_e]
-        )
-        # ready ring: entries append in execution order (ring indices are
-        # the exclusive running count of owned entries)
-        ring = est.ready
-        cap = ring.client.shape[1]
-        rr = jnp.cumsum(owned_e.astype(jnp.int32)) - owned_e.astype(jnp.int32)
-        room = (ring.push[p] + rr - ring.pop[p]) < cap
-        do_e = owned_e & room
-        ridx = jnp.where(do_e, (ring.push[p] + rr) % cap, cap)  # cap = drop
-        ring = ring._replace(
-            client=ring.client.at[p, ridx].set(client_e, mode="drop"),
-            rifl_seq=ring.rifl_seq.at[p, ridx].set(rifl_e, mode="drop"),
-            kslot=ring.kslot.at[p, ridx].set(k_of_e, mode="drop"),
-            value=ring.value.at[p, ridx].set(old_e, mode="drop"),
-            push=ring.push.at[p].add(do_e.sum()),
-            overflow=ring.overflow.at[p].add((owned_e & ~room).sum()),
+        ring = ready_push_batch(
+            est.ready, p, owned_e, client_e, rifl_e, k_of_e, old_e
         )
         # ExecutionDelay: vertex creation -> execution (graph/mod.rs:518)
         HB = est.delay_hist.shape[1]

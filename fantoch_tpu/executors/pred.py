@@ -30,10 +30,18 @@ import jax.numpy as jnp
 from ..engine.types import ExecutorDef
 from ..ops.pred_ready import pred_ready
 from ..protocols.common.bitmap import bm_pack, bm_words
-from ..protocols.common.mhist import hist_add, hist_init
-from .ready import ReadyRing, ready_capacity, ready_drain, ready_init, ready_push, writer_id
-
-ORDER_HASH_MULT = jnp.int32(0x01000193)
+from ..protocols.common.mhist import hist_init
+from .ready import (
+    ReadyRing,
+    kv_apply_batch,
+    mult_powers,
+    ready_capacity,
+    ready_drain,
+    ready_init,
+    ready_push,
+    ready_push_batch,
+    writer_id,
+)
 
 
 class PredExecState(NamedTuple):
@@ -81,41 +89,87 @@ def make_executor(n: int, max_seq: int, execute_at_commit: bool = False) -> Exec
         return pred_ready(est.deps[p], est.committed[p], est.executed[p], est.clock[p])
 
     def _try_execute(ctx, est: PredExecState, p, now):
+        """Execute ready commands to fixpoint. Each `lax.while_loop` trip
+        executes the WHOLE current ready set in ascending (clock, dot) order
+        — trip count is the cascade depth (executions unblocking lower-clock
+        waiters), not the batch size. Equivalent to popping one command per
+        trip: two commands ready in the same batch never conflict (a
+        conflicting lower-clock command is a phase-two predecessor, so its
+        unexecuted presence would block the other), hence every per-key
+        projection of the execution order — the KVS write order, returned
+        values, and rolling order hashes — is unchanged."""
         KPC = ctx.spec.keys_per_command
-        dots = jnp.arange(DOTS, dtype=jnp.int32)
-        est = est._replace(chain_max=est.chain_max.at[p].max(_ready_set(est, p).sum()))
+        K = est.kvs.shape[1]
+        E = DOTS * KPC
+        e_iota = jnp.arange(E, dtype=jnp.int32)
+        pow_tab = jnp.asarray(mult_powers(E + 1), jnp.uint32)
+        big = jnp.int32(2**30)
+        est = est._replace(
+            chain_max=est.chain_max.at[p].max(_ready_set(est, p).sum())
+        )
 
         def cond(e):
             return _ready_set(e, p).any()
 
         def body(e):
-            ready = _ready_set(e, p)
-            # execute the (clock, dot)-minimal ready command
-            ckey = jnp.where(ready, e.clock[p], jnp.int32(2**30))
-            cmin = ckey.min()
-            d = jnp.where(ckey == cmin, dots, jnp.int32(2**30)).min()
-            client = ctx.cmds.client[d]
-            rifl = ctx.cmds.rifl_seq[d]
-            kvs, oh, oc, ring = e.kvs, e.order_hash, e.order_cnt, e.ready
-            wr = ~ctx.cmds.read_only[d]
-            for k in range(KPC):
-                key = ctx.cmds.keys[d, k]
-                old = kvs[p, key]
-                kvs = kvs.at[p, key].set(
-                    jnp.where(wr, writer_id(client, rifl), old)
+            U = _ready_set(e, p)  # [DOTS]
+            ucount = U.sum()
+            # ascending (clock, dot): two stable sorts (dot, then clock)
+            perm_d = jnp.argsort(
+                jnp.where(U, jnp.arange(DOTS, dtype=jnp.int32), big),
+                stable=True,
+            ).astype(jnp.int32)
+            ck = jnp.where(U, e.clock[p], big)
+            perm = perm_d[
+                jnp.argsort(
+                    jnp.where(U[perm_d], ck[perm_d], big), stable=True
                 )
-                oh = oh.at[p, key].set(oh[p, key] * ORDER_HASH_MULT + (d + 1))
-                oc = oc.at[p, key].add(1)
-                ring = ready_push(ring, p, client, rifl, kslot=k, value=old)
+            ].astype(jnp.int32)
+            s_of_e = perm[e_iota // KPC]
+            k_of_e = e_iota % KPC
+            valid_e = (e_iota // KPC) < ucount
+            key_e = ctx.cmds.keys[s_of_e, k_of_e]
+            client_e = ctx.cmds.client[s_of_e]
+            rifl_e = ctx.cmds.rifl_seq[s_of_e]
+            wid_e = writer_id(client_e, rifl_e)
+            wr_e = valid_e & ~ctx.cmds.read_only[s_of_e]
+            before = e_iota[:, None] > e_iota[None, :]
+            samekey = key_e[:, None] == key_e[None, :]
+            own_col = valid_e[None, :]
+            c_e = (before & samekey & own_col).sum(axis=1)
+            m_of_e = (samekey & own_col).sum(axis=1)
+            scat = jnp.where(valid_e, key_e, K)
+            m_k = jnp.zeros((K,), jnp.int32).at[scat].add(1, mode="drop")
+            term_e = (s_of_e + 1).astype(jnp.uint32) * pow_tab[
+                jnp.clip(m_of_e - 1 - c_e, 0, E)
+            ]
+            add_k = jnp.zeros((K,), jnp.uint32).at[scat].add(
+                term_e, mode="drop"
+            )
+            oh_row = (
+                e.order_hash[p].astype(jnp.uint32)
+                * pow_tab[jnp.clip(m_k, 0, E)]
+                + add_k
+            ).astype(jnp.int32)
+            kvs_row, old_e = kv_apply_batch(
+                e.kvs[p], e_iota, key_e, wid_e, wr_e, K
+            )
+            ring = ready_push_batch(
+                e.ready, p, valid_e, client_e, rifl_e, k_of_e, old_e
+            )
+            # ExecutionDelay: commit receipt -> execution (pred/mod.rs:360)
+            HB = e.delay_hist.shape[1]
+            dclip = jnp.clip(now - e.recv_ms[p], 0, HB - 1)
             return e._replace(
-                kvs=kvs,
-                order_hash=oh,
-                order_cnt=oc,
+                kvs=e.kvs.at[p].set(kvs_row),
+                order_hash=e.order_hash.at[p].set(oh_row),
+                order_cnt=e.order_cnt.at[p].add(m_k),
                 ready=ring,
-                executed=e.executed.at[p, d].set(True),
-                executed_count=e.executed_count.at[p].add(1),
-                # ExecutionDelay: commit receipt -> execution (pred/mod.rs:360)
-                delay_hist=hist_add(e.delay_hist, p, now - e.recv_ms[p, d], True),
+                executed=e.executed.at[p].set(e.executed[p] | U),
+                executed_count=e.executed_count.at[p].add(ucount),
+                delay_hist=e.delay_hist.at[p, jnp.where(U, dclip, HB)].add(
+                    1, mode="drop"
+                ),
             )
 
         return jax.lax.while_loop(cond, body, est)

@@ -23,6 +23,25 @@ def writer_id(client, rifl_seq):
     return client * (1 << 16) + rifl_seq
 
 
+# rolling execution-order hash multiplier (ExecutionOrderMonitor analogue)
+ORDER_HASH_MULT = 0x01000193  # FNV-ish odd multiplier
+
+
+def mult_powers(count: int):
+    """uint32 powers ORDER_HASH_MULT^i for i in [0, count) — the constant
+    table batched executors use to apply a whole execution batch's rolling
+    hash in closed form (host-computed)."""
+    import numpy as np
+
+    out = np.empty(count, np.uint32)
+    x = np.uint32(1)
+    with np.errstate(over="ignore"):
+        for i in range(count):
+            out[i] = x
+            x = np.uint32(x * np.uint32(ORDER_HASH_MULT))
+    return out
+
+
 def ready_capacity(spec) -> int:
     """Worst-case ready-ring occupancy: a replica that no client is attached
     to can lag arbitrarily and then execute its whole backlog in a single
@@ -73,6 +92,50 @@ def ready_push(ring: ReadyRing, p, client, rifl_seq, enable=True, kslot=0,
         ),
         push=ring.push.at[p].add(do.astype(jnp.int32)),
         overflow=ring.overflow.at[p].add((enable & full).astype(jnp.int32)),
+    )
+
+
+def kv_apply_batch(kvs_row, e_iota, key_e, wid_e, wr_e, K: int):
+    """Apply one ordered batch of key-entries to a KVS row: last-write-wins
+    per key, and each entry's returned value is the previous same-key write
+    in batch order (or the pre-batch store value) — bit-identical to writing
+    the entries one at a time. `wr_e` must already include entry validity.
+    Returns (new_row, old_e)."""
+    before = e_iota[:, None] > e_iota[None, :]
+    after = e_iota[:, None] < e_iota[None, :]
+    samekey = key_e[:, None] == key_e[None, :]
+    last_w = wr_e & ~(after & samekey & wr_e[None, :]).any(axis=1)
+    new_row = kvs_row.at[jnp.where(last_w, key_e, K)].set(wid_e, mode="drop")
+    pidx = jnp.where(
+        before & samekey & wr_e[None, :], e_iota[None, :], -1
+    ).max(axis=1)
+    old_e = jnp.where(
+        pidx >= 0,
+        wid_e[jnp.clip(pidx, 0, e_iota.shape[0] - 1)],
+        kvs_row[key_e],
+    )
+    return new_row, old_e
+
+
+def ready_push_batch(
+    ring: ReadyRing, p, valid_e, client_e, rifl_e, kslot_e, value_e
+) -> ReadyRing:
+    """Append one ordered batch of results to the ring — same indices,
+    capacity accounting and overflow counting as pushing one entry at a
+    time (room is monotone along the batch, so the cumsum prefix check is
+    exact)."""
+    cap = ring.client.shape[1]
+    rr = jnp.cumsum(valid_e.astype(jnp.int32)) - valid_e.astype(jnp.int32)
+    room = (ring.push[p] + rr - ring.pop[p]) < cap
+    do = valid_e & room
+    idx = jnp.where(do, (ring.push[p] + rr) % cap, cap)  # cap = dropped
+    return ring._replace(
+        client=ring.client.at[p, idx].set(client_e, mode="drop"),
+        rifl_seq=ring.rifl_seq.at[p, idx].set(rifl_e, mode="drop"),
+        kslot=ring.kslot.at[p, idx].set(kslot_e, mode="drop"),
+        value=ring.value.at[p, idx].set(value_e, mode="drop"),
+        push=ring.push.at[p].add(do.sum()),
+        overflow=ring.overflow.at[p].add((valid_e & ~room).sum()),
     )
 
 

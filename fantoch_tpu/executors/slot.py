@@ -19,7 +19,16 @@ import jax.numpy as jnp
 
 from ..core import ids
 from ..engine.types import ExecutorDef
-from .ready import ReadyRing, ready_capacity, ready_drain, ready_init, ready_push, writer_id
+from .ready import (
+    ReadyRing,
+    kv_apply_batch,
+    ready_capacity,
+    ready_drain,
+    ready_init,
+    ready_push,
+    ready_push_batch,
+    writer_id,
+)
 
 EXEC_WIDTH = 2
 
@@ -65,33 +74,47 @@ def make_executor(n: int, execute_at_commit: bool = False) -> ExecutorDef:
             return est._replace(kvs=kvs, ready=ready)
         est = est._replace(buf_dot=est.buf_dot.at[p, slot - 1].set(dot))
 
-        # try_next_slot: execute the contiguous prefix (slot.rs:89-96)
-        def cond(e: SlotExecState):
-            nxt = e.next_slot[p]
-            return (nxt <= SLOTS) & (e.buf_dot[p, jnp.clip(nxt - 1, 0, SLOTS - 1)] >= 0)
-
-        def body(e: SlotExecState):
-            nxt = e.next_slot[p]
-            d = ids.dot_slot(e.buf_dot[p, nxt - 1], ctx.spec.max_seq)
-            client = ctx.cmds.client[d]
-            rifl = ctx.cmds.rifl_seq[d]
-            kvs, ready = e.kvs, e.ready
-            wr = ~ctx.cmds.read_only[d]
-            for k in range(KPC):
-                key = ctx.cmds.keys[d, k]
-                old = kvs[p, key]
-                kvs = kvs.at[p, key].set(
-                    jnp.where(wr, writer_id(client, rifl), old)
-                )
-                ready = ready_push(ready, p, client, rifl, kslot=k, value=old)
-            return e._replace(
-                kvs=kvs,
-                ready=ready,
-                buf_dot=e.buf_dot.at[p, nxt - 1].set(-1),
-                next_slot=e.next_slot.at[p].add(1),
-            )
-
-        return jax.lax.while_loop(cond, body, est)
+        # try_next_slot (slot.rs:89-96): execute the whole contiguous
+        # buffered prefix in one vectorized pass — slot order IS execution
+        # order, so the run length is a closed form (no data-dependent
+        # `lax.while_loop` trip count, which costs max-over-batch under vmap)
+        K = est.kvs.shape[1]
+        nxt = est.next_slot[p]  # 1-based
+        j = jnp.arange(SLOTS, dtype=jnp.int32)
+        pos = jnp.clip(nxt - 1 + j, 0, SLOTS - 1)
+        present = (est.buf_dot[p, pos] >= 0) & (nxt - 1 + j < SLOTS)
+        run = jnp.cumprod(present.astype(jnp.int32)).sum()  # prefix length
+        # entries: run slots x key slots, slot-major
+        E = SLOTS * KPC
+        e_iota = jnp.arange(E, dtype=jnp.int32)
+        r_of_e = e_iota // KPC
+        k_of_e = e_iota % KPC
+        valid_e = r_of_e < run
+        slot_e = jnp.clip(nxt - 1 + r_of_e, 0, SLOTS - 1)
+        d_of_e = ids.dot_slot(
+            jnp.maximum(est.buf_dot[p, slot_e], 0), ctx.spec.max_seq
+        )
+        key_e = ctx.cmds.keys[d_of_e, k_of_e]
+        client_e = ctx.cmds.client[d_of_e]
+        rifl_e = ctx.cmds.rifl_seq[d_of_e]
+        wid_e = writer_id(client_e, rifl_e)
+        wr_e = valid_e & ~ctx.cmds.read_only[d_of_e]
+        # last write per key wins; per-entry returned value is the previous
+        # same-key write in order (shared batch helpers, executors/ready.py)
+        kvs_row, old_e = kv_apply_batch(
+            est.kvs[p], e_iota, key_e, wid_e, wr_e, K
+        )
+        ring = ready_push_batch(
+            est.ready, p, valid_e, client_e, rifl_e, k_of_e, old_e
+        )
+        return est._replace(
+            kvs=est.kvs.at[p].set(kvs_row),
+            ready=ring,
+            buf_dot=est.buf_dot.at[
+                p, jnp.where(j < run, nxt - 1 + j, SLOTS)
+            ].set(-1, mode="drop"),
+            next_slot=est.next_slot.at[p].add(run),
+        )
 
     def drain(ctx, est: SlotExecState, p):
         ready, res = ready_drain(est.ready, p, ctx.spec.max_res)

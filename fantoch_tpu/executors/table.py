@@ -42,7 +42,16 @@ from ..core import ids
 from ..engine.types import ExecutorDef
 from ..ops import dense
 from ..protocols.common.sharding import key_shard
-from .ready import ReadyRing, ready_capacity, ready_drain, ready_init, ready_push, writer_id
+from .ready import (
+    ReadyRing,
+    kv_apply_batch,
+    mult_powers,
+    ready_capacity,
+    ready_drain,
+    ready_init,
+    ready_push_batch,
+    writer_id,
+)
 
 ATTACHED = 0
 DETACHED = 1
@@ -50,7 +59,6 @@ DETACHED = 1
 # out-of-order vote-range buffer depth per (key, voter)
 PENDING_RANGES = 8
 
-ORDER_HASH_MULT = jnp.int32(0x01000193)  # FNV-ish odd multiplier
 
 
 def exec_width(n: int) -> int:
@@ -166,7 +174,15 @@ def make_executor(n: int, shards: int = 1) -> ExecutorDef:
 
     def _stable_ops(ctx, est: TableExecState, p, key):
         """Execute every pending entry on `key` with clock <= stable clock,
-        in (clock, dot) order (table/mod.rs stable_ops + stable_clock)."""
+        in (clock, dot) order (table/mod.rs stable_ops + stable_clock).
+
+        One vectorized pass: the eligible set is fixed at entry (executing an
+        entry changes neither the stable clock nor other entries' clocks), so
+        sort it by (clock, generation-dot, key-slot) and apply the whole
+        batch — execution order, rolling order hash, KVS read/write
+        interleaving and ready-ring entry order are bit-identical to popping
+        one entry per `lax.while_loop` trip, without the data-dependent trip
+        count (which costs max-over-batch iterations under `vmap`)."""
         KPC = ctx.spec.keys_per_command
         DOTS = est.tbl_clock.shape[1]
         threshold = ctx.env.threshold
@@ -179,56 +195,86 @@ def make_executor(n: int, shards: int = 1) -> ExecutorDef:
         )  # ascending [n]
         stable_clock = frontiers[n - threshold]
 
-        dots = jnp.arange(DOTS, dtype=jnp.int32)
+        on_key = (ctx.cmds.keys == key) & est.tbl_pending[p]  # [DOTS, KPC]
+        edot = on_key.any(axis=1) & (est.tbl_clock[p] <= stable_clock)
+        elig = on_key & edot[:, None]
 
-        def key_pending(e):
-            # [DOTS] does this dot have a pending entry on `key`?
-            on_key = (ctx.cmds.keys[:, :] == key) & e.tbl_pending[p]  # [DOTS, KPC]
-            return on_key.any(axis=1), on_key
+        # dot order: (clock, generation) via two stable sorts; entries are
+        # dot-major with key slots ascending — exactly the sequential pop
+        # order (the lexicographic-min dot stays minimal until all its
+        # pending slots on the key drain)
+        big = jnp.int32(2**30)
+        perm_d = jnp.argsort(
+            jnp.where(edot, est.vdot[p], big), stable=True
+        ).astype(jnp.int32)
+        ck = jnp.where(edot, est.tbl_clock[p], big)
+        perm = perm_d[
+            jnp.argsort(jnp.where(edot[perm_d], ck[perm_d], big), stable=True)
+        ].astype(jnp.int32)
+        E = DOTS * KPC
+        e_iota = jnp.arange(E, dtype=jnp.int32)
+        s_of_e = perm[e_iota // KPC]  # [E] dot slot per entry
+        k_of_e = e_iota % KPC
+        valid_e = elig[s_of_e, k_of_e]
+        cum = jnp.cumsum(valid_e.astype(jnp.int32)) - valid_e.astype(jnp.int32)
+        total = valid_e.sum()
 
-        def cond(e):
-            pend, _ = key_pending(e)
-            clocks = jnp.where(pend, e.tbl_clock[p], jnp.int32(2**30))
-            return clocks.min() <= stable_clock
+        client_e = ctx.cmds.client[s_of_e]
+        rifl_e = ctx.cmds.rifl_seq[s_of_e]
+        wid_e = writer_id(client_e, rifl_e)
+        wr_e = valid_e & ~ctx.cmds.read_only[s_of_e]
 
-        def body(e):
-            pend, on_key = key_pending(e)
-            clocks = jnp.where(pend, e.tbl_clock[p], jnp.int32(2**30))
-            cmin = clocks.min()
-            # lexicographic (clock, dot) min: tie-break by GENERATION (ring
-            # slots can wrap, so slot order is not dot order)
-            d = jnp.argmin(
-                jnp.where(clocks == cmin, e.vdot[p], jnp.int32(2**30))
-            ).astype(jnp.int32)
-            client = ctx.cmds.client[d]
-            rifl = ctx.cmds.rifl_seq[d]
-            kslot = jnp.argmax(on_key[d])
-            done = e.done_cnt[p, d] + 1
-            if shards == 1:
-                exp = jnp.int32(KPC)
-            else:
-                # only this shard's key slots produce table entries
-                myshard = ctx.env.shard_of[ctx.pid]
-                exp = (key_shard(ctx.cmds.keys[d], shards) == myshard).sum()
-            old = e.kvs[p, key]
-            wr = ~ctx.cmds.read_only[d]  # Gets never mutate the store
-            return e._replace(
-                kvs=e.kvs.at[p, key].set(
-                    jnp.where(wr, writer_id(client, rifl), old)
-                ),
-                tbl_pending=e.tbl_pending.at[p, d, kslot].set(False),
-                done_cnt=e.done_cnt.at[p, d].set(done),
-                executed=e.executed.at[p, d].set(done == exp),
-                order_hash=e.order_hash.at[p, key].set(
-                    e.order_hash[p, key] * ORDER_HASH_MULT + (d + 1)
-                ),
-                order_cnt=e.order_cnt.at[p, key].add(1),
-                executed_count=e.executed_count.at[p].add(1),
-                ready=ready_push(e.ready, p, client, rifl, kslot=kslot,
-                                 value=old),
-            )
+        # rolling hash over the batch in closed form (uint32 wraps = the
+        # int32 state's two's-complement wraps)
+        pow_tab = jnp.asarray(mult_powers(E + 1), jnp.uint32)
+        term = (s_of_e + 1).astype(jnp.uint32) * pow_tab[
+            jnp.clip(total - 1 - cum, 0, E)
+        ]
+        add = jnp.where(valid_e, term, jnp.uint32(0)).sum()
+        oh_new = (
+            est.order_hash[p, key].astype(jnp.uint32) * pow_tab[total] + add
+        ).astype(jnp.int32)
 
-        est = jax.lax.while_loop(cond, body, est)
+        # KVS: last write wins; per-entry returned value is the previous
+        # write in batch order (all entries share `key`, so the shared batch
+        # helper sees a constant key row)
+        key_e = jnp.full((E,), key, jnp.int32)
+        kvs_row, old_e = kv_apply_batch(
+            est.kvs[p], e_iota, key_e, wid_e, wr_e, est.kvs.shape[1]
+        )
+
+        # per-dot bookkeeping
+        cnt_d = (
+            jnp.zeros((DOTS,), jnp.int32)
+            .at[jnp.where(valid_e, s_of_e, DOTS)]
+            .add(1, mode="drop")
+        )
+        done_new = est.done_cnt[p] + cnt_d
+        if shards == 1:
+            exp_d = jnp.full((DOTS,), KPC, jnp.int32)
+        else:
+            # only this shard's key slots produce table entries
+            myshard = ctx.env.shard_of[ctx.pid]
+            exp_d = (key_shard(ctx.cmds.keys, shards) == myshard).sum(axis=1)
+        executed_new = jnp.where(
+            cnt_d > 0, done_new == exp_d, est.executed[p]
+        )
+
+        # ready ring: entries append in batch order
+        ring = ready_push_batch(
+            est.ready, p, valid_e, client_e, rifl_e, k_of_e, old_e
+        )
+
+        est = est._replace(
+            kvs=est.kvs.at[p].set(kvs_row),
+            tbl_pending=est.tbl_pending.at[p].set(est.tbl_pending[p] & ~elig),
+            done_cnt=est.done_cnt.at[p].set(done_new),
+            executed=est.executed.at[p].set(executed_new),
+            order_hash=est.order_hash.at[p, key].set(oh_new),
+            order_cnt=est.order_cnt.at[p, key].add(total),
+            executed_count=est.executed_count.at[p].add(total),
+            ready=ring,
+        )
 
         # advance the contiguous fully-executed frontier per coordinator
         fr = ids.advance_frontiers(
