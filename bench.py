@@ -142,14 +142,14 @@ def main():
     chunk_env = os.environ.get("BENCH_CHUNK_STEPS")
     n = 3
     # chunk lengths keep each device call well under the tunnel's ~40s
-    # stall watchdog (a call that trips it faults the worker and degrades
-    # subsequent compiles); batch sizes picked for the flat-loop engine
+    # stall watchdog (a tripped watchdog faults the worker and degrades
+    # everything after it); batch sizes picked for the flat-loop engine
     # where per-trip cost scales ~linearly with batch
     runs = [
         # (name, pdef, configs, commands/client, window, chunk_steps)
-        ("basic", basic_proto.make_protocol(n, 1), int(256 * scale), 100, 32, 40_000),
-        ("tempo", tempo_proto.make_protocol(n, 1), int(64 * scale), 50, 32, 4_000),
-        ("atlas", atlas_proto.make_protocol(n, 1), int(64 * scale), 50, 24, 4_000),
+        ("basic", basic_proto.make_protocol(n, 1), int(256 * scale), 100, 32, 5_000),
+        ("tempo", tempo_proto.make_protocol(n, 1), int(64 * scale), 25, 32, 2_000),
+        ("atlas", atlas_proto.make_protocol(n, 1), int(64 * scale), 25, 24, 2_000),
     ]
     total_events, total_time = 0, 0.0
     all_ok = True

@@ -116,6 +116,12 @@ class SimSpec:
     # bit-reproducible by the native C++ oracle (native/atlas_oracle.cpp),
     # unlike `reorder`'s device PRNG — used by oracle-equality tests
     reorder_hash: bool = False
+    # opt-in execution-order log: every drained executor result is recorded
+    # per process as (client, rifl, kslot), in execution order — the raw
+    # material for the exact per-key order-divergence diff the reference
+    # prints when replicas disagree (fantoch_ps/src/protocol/mod.rs:787-871;
+    # summary.explain_order_divergence renders it)
+    order_log: bool = False
 
     @property
     def dots(self) -> int:
@@ -209,6 +215,9 @@ class SimState(NamedTuple):
     hist_overflow: jnp.ndarray
     lat_sum: jnp.ndarray  # [C] int32
     lat_cnt: jnp.ndarray  # [C] int32
+    # execution-order log (spec.order_log builds; [n, 1, 3] dummies else)
+    olog: jnp.ndarray  # [n, L, 3] int32 (client, rifl, kslot) per drain
+    olog_len: jnp.ndarray  # [n] int32
     # plugged-in state
     proto: Any
     exec: Any
@@ -397,6 +406,24 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
     # executor result routing (global, dense)
     # ------------------------------------------------------------------
 
+    def _log_order(st: SimState, res: ResOut) -> SimState:
+        """Append every drained result (execution order per process) to the
+        order log — each replica executes every command, so the log is the
+        full per-process execution sequence (spec.order_log builds only)."""
+        if not spec.order_log:
+            return st
+        L = st.olog.shape[1]
+        rank = jnp.cumsum(res.valid.astype(jnp.int32), axis=1) - res.valid
+        idx = jnp.where(
+            res.valid, jnp.minimum(st.olog_len[:, None] + rank, L - 1), L
+        )  # [n, MR]; L = dropped
+        rows = jnp.stack([res.client, res.rifl_seq, res.kslot], axis=-1)
+        pi = jnp.broadcast_to(proc_ids[:, None], idx.shape)
+        return st._replace(
+            olog=st.olog.at[pi, idx].set(rows, mode="drop"),
+            olog_len=st.olog_len + res.valid.sum(axis=1),
+        )
+
     def _route_results(st: SimState, env: Env, res: ResOut) -> Tuple[SimState, Candidates]:
         """Batch of executor results from all processes ([n, MR] fields) ->
         c_got accounting + reply candidates.
@@ -408,6 +435,7 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         per-key partial results arrived, and only the completing partial
         emits the client reply.
         """
+        st = _log_order(st, res)
         client = res.client  # [n, MR]
         cclip = jnp.clip(client, 0, C - 1)
         oh_cli = dense.oh(cclip, C)  # [n, MR, C]
@@ -1009,90 +1037,133 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             fns.append(fn)
         return fns
 
+    def _pad_ob(ob: Outbox, rows: int, width: int) -> Outbox:
+        have, hw = ob.valid.shape[0], ob.payload.shape[1]
+        if have == rows and hw == width:
+            return ob
+        pad = rows - have
+        payload = ob.payload
+        if hw < width:
+            payload = jnp.concatenate(
+                [payload, jnp.zeros((have, width - hw), jnp.int32)], axis=1
+            )
+        return Outbox(
+            valid=jnp.concatenate([ob.valid, jnp.zeros((pad,), jnp.bool_)]),
+            tgt_mask=jnp.concatenate(
+                [ob.tgt_mask, jnp.zeros((pad,), jnp.int32)]
+            ),
+            kind=jnp.concatenate([ob.kind, jnp.zeros((pad,), jnp.int32)]),
+            payload=jnp.concatenate(
+                [payload, jnp.zeros((pad, width), jnp.int32)]
+            ),
+        )
+
     def _fire_periodic(env: Env, st: SimState) -> SimState:
-        """Fire ALL due periodic slots, slot-major (slot k for every due
-        process, then slot k+1, ...) — the canonical same-instant order the
-        native oracle and the distributed runner reproduce: deliverable
-        messages drained first, then every due timer, then cascades."""
+        """Fire the LOWEST due periodic slot for every due process, in one
+        row pass (a `lax.switch` over the slot handlers). This is the
+        canonical same-instant discipline every implementation follows — the
+        flat loop, the native oracles (native/*.cpp) and the distributed
+        runner (parallel/quantum.py): drain deliverable messages, fire the
+        lowest due slot, drain the cascades, repeat until the instant is
+        quiescent. One pass per firing instead of one per slot keeps the
+        trip cost flat (under vmap all slot branches are computed either
+        way; the per-pass row machinery is what collapses)."""
         cmds = CmdView(st.cmd_client, st.cmd_rifl, st.cmd_keys, st.cmd_ro)
-        blocks: List[Candidates] = []
+        due_mat = st.per_next <= st.now  # [n, NPER]
+        k_star = jnp.argmax(due_mat.any(axis=0)).astype(jnp.int32)
+        k_oh = jnp.arange(NPER, dtype=jnp.int32)[None, :] == k_star
+        due = (due_mat & k_oh).any(axis=1)  # [n]
+        st = st._replace(
+            per_next=st.per_next
+            + jnp.where(k_oh & due[:, None], interval_arr[None, :], 0),
+            step=st.step + due.sum(),
+        )
         fns = _slot_fns(st.now)
 
-        def periodic_rows(st, due, fn):
-            """Apply `fn(ctx, proto1, exec1) -> (proto1, exec1, Outbox,
-            ResOut)` per process with due-masking."""
-
-            if ROW_LOOP:
-                prots, execs, obs, ress = [], [], [], []
-                for pid in range(n):
-                    proto1 = jax.tree_util.tree_map(
-                        lambda a: a[pid:pid + 1], st.proto
-                    )
-                    exec1 = jax.tree_util.tree_map(
-                        lambda a: a[pid:pid + 1], st.exec
-                    )
-                    ctx = Ctx(spec=spec, env=_slice_env(env, pid), cmds=cmds,
-                              pid=jnp.int32(pid))
-                    ob_aval = jax.eval_shape(
-                        lambda pr, ex: fn(ctx, pr, ex), proto1, exec1
-                    )[2]
-
-                    def active(_, ctx=ctx, proto1=proto1, exec1=exec1):
-                        return fn(ctx, proto1, exec1)
-
-                    def idle(_, proto1=proto1, exec1=exec1, ob_aval=ob_aval):
-                        return (
-                            proto1, exec1,
-                            Outbox(
-                                valid=jnp.zeros(ob_aval.valid.shape, jnp.bool_),
-                                tgt_mask=jnp.zeros(ob_aval.tgt_mask.shape, jnp.int32),
-                                kind=jnp.zeros(ob_aval.kind.shape, jnp.int32),
-                                payload=jnp.zeros(ob_aval.payload.shape, jnp.int32),
-                            ),
-                            _empty_res(),
-                        )
-
-                    pst, est, ob, res = jax.lax.cond(due[pid], active, idle, None)
-                    prots.append(pst)
-                    execs.append(est)
-                    obs.append(ob)
-                    ress.append(res)
-                cat = lambda *xs: jnp.concatenate(xs)
-                return (
-                    jax.tree_util.tree_map(cat, *prots),
-                    jax.tree_util.tree_map(cat, *execs),
-                    jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *obs),
-                    jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *ress),
+        def padded_branches(ctx, proto1, exec1):
+            shapes = [
+                jax.eval_shape(
+                    lambda pr, ex, fn=fn: fn(ctx, pr, ex), proto1, exec1
+                )[2]
+                for fn in fns
+            ]
+            obr = max(s.valid.shape[0] for s in shapes)
+            obw = max(s.payload.shape[1] for s in shapes)
+            return [
+                (
+                    lambda args, fn=fn: (
+                        lambda o: (o[0], o[1], _pad_ob(o[2], obr, obw), o[3])
+                    )(fn(ctx, args[0], args[1]))
                 )
+                for fn in fns
+            ], (obr, obw)
+
+        if ROW_LOOP:
+            prots, execs, obs, ress = [], [], [], []
+            for pid in range(n):
+                proto1 = jax.tree_util.tree_map(
+                    lambda a: a[pid:pid + 1], st.proto
+                )
+                exec1 = jax.tree_util.tree_map(
+                    lambda a: a[pid:pid + 1], st.exec
+                )
+                ctx = Ctx(spec=spec, env=_slice_env(env, pid), cmds=cmds,
+                          pid=jnp.int32(pid))
+                branches, (obr, obw) = padded_branches(ctx, proto1, exec1)
+
+                def active(args, branches=branches):
+                    return jax.lax.switch(k_star, branches, args)
+
+                def idle(args, obr=obr, obw=obw):
+                    proto1, exec1 = args
+                    return (
+                        proto1, exec1,
+                        Outbox(
+                            valid=jnp.zeros((obr,), jnp.bool_),
+                            tgt_mask=jnp.zeros((obr,), jnp.int32),
+                            kind=jnp.zeros((obr,), jnp.int32),
+                            payload=jnp.zeros((obr, obw), jnp.int32),
+                        ),
+                        _empty_res(),
+                    )
+
+                pst, est, ob, res = jax.lax.cond(
+                    due[pid], active, idle, (proto1, exec1)
+                )
+                prots.append(pst)
+                execs.append(est)
+                obs.append(ob)
+                ress.append(res)
+            cat = lambda *xs: jnp.concatenate(xs)
+            proto, exc, ob, res = (
+                jax.tree_util.tree_map(cat, *prots),
+                jax.tree_util.tree_map(cat, *execs),
+                jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *obs),
+                jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *ress),
+            )
+        else:
 
             def row(pid, env_row, proto_row, exec_row, due_p):
                 proto1 = _lift(proto_row)
                 exec1 = _lift(exec_row)
                 ctx = Ctx(spec=spec, env=_lift_env(env_row), cmds=cmds, pid=pid)
-                pst, est, ob, res = fn(ctx, proto1, exec1)
+                branches, _ = padded_branches(ctx, proto1, exec1)
+                pst, est, ob, res = jax.lax.switch(
+                    k_star, branches, (proto1, exec1)
+                )
                 pst = _tree_select(due_p, pst, proto1)
                 est = _tree_select(due_p, est, exec1)
                 ob = ob._replace(valid=ob.valid & due_p)
                 res = res._replace(valid=res.valid & due_p)
                 return _unlift(pst), _unlift(est), ob, res
 
-            return jax.vmap(row, in_axes=(0, ENV_AXES, 0, 0, 0))(
-                proc_ids, env, st.proto, st.exec, due
-            )
-
-        for k in range(NPER):
-            due = st.per_next[:, k] <= st.now  # [n]
-            st = st._replace(
-                per_next=st.per_next.at[:, k].add(
-                    jnp.where(due, interval_arr[k], 0)
-                ),
-                step=st.step + due.sum(),
-            )
-            proto, exc, ob, res = periodic_rows(st, due, fns[k])
-            st = st._replace(proto=proto, exec=exc)
-            blocks.append(_expand_outbox(env, ob))
-            st, replies = _route_results(st, env, res)
-            blocks.append(replies)
+            proto, exc, ob, res = jax.vmap(
+                row, in_axes=(0, ENV_AXES, 0, 0, 0)
+            )(proc_ids, env, st.proto, st.exec, due)
+        st = st._replace(proto=proto, exec=exc)
+        blocks = [_expand_outbox(env, ob)]
+        st, replies = _route_results(st, env, res)
+        blocks.append(replies)
         return _insert(st, env, _cat_cands(blocks))
 
     def _empty_ob():
@@ -1197,6 +1268,15 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             hist_overflow=jnp.int32(0),
             lat_sum=jnp.zeros((C,), jnp.int32),
             lat_cnt=jnp.zeros((C,), jnp.int32),
+            olog=jnp.zeros(
+                (
+                    n,
+                    C * spec.commands_per_client * KPC if spec.order_log else 1,
+                    3,
+                ),
+                jnp.int32,
+            ),
+            olog_len=jnp.zeros((n,), jnp.int32),
             proto=pdef.init(spec, env),
             exec=exdef.init(spec, env),
         )

@@ -37,6 +37,7 @@ def build_spec(
     extra_ms: int = 1000,
     reorder: bool = False,
     reorder_hash: bool = False,
+    order_log: bool = False,
     max_steps: int = 1 << 30,
     max_res: int = 4,
     open_loop_interval_ms: Optional[int] = None,
@@ -144,6 +145,7 @@ def build_spec(
         extra_ms=extra_ms,
         reorder=reorder,
         reorder_hash=reorder_hash,
+        order_log=order_log,
         max_steps=max_steps,
         max_res=max_res,
         open_loop_interval_ms=open_loop_interval_ms,

@@ -56,6 +56,79 @@ def client_latencies(
     return out
 
 
+def execution_orders(st: SimState, workload, env: Env):
+    """Per-(process, key) execution order from the order log
+    (`spec.order_log` builds): key -> list per process of (client, rifl)
+    in execution order — the dense analogue of the reference's
+    `ExecutionOrderMonitor` contents (fantoch/src/executor/monitor.rs)."""
+    from ..core import workload as workload_mod
+
+    olog = np.asarray(st.olog)  # [n, L, 3]
+    olen = np.asarray(st.olog_len)
+    n = olog.shape[0]
+    assert olog.shape[1] > 1, "run the engine with build_spec(order_log=True)"
+    consts = workload_mod.WorkloadConsts.build(workload)
+    import jax as _jax
+    import jax.numpy as jnp
+
+    key_fn = _jax.jit(
+        lambda c, i: workload_mod.sample_command_keys(
+            consts,
+            _jax.random.wrap_key_data(jnp.asarray(env.seed)),
+            c,
+            i,
+            jnp.asarray(env.conflict_rate),
+            jnp.asarray(env.read_only_pct),
+        )[0]
+    )
+    orders: Dict[int, list] = {}
+    keycache: Dict[Tuple[int, int], np.ndarray] = {}
+    for p in range(n):
+        for e in range(int(olen[p])):
+            client, rifl, kslot = (int(x) for x in olog[p, e])
+            ck = (client, rifl)
+            if ck not in keycache:
+                keycache[ck] = np.asarray(key_fn(client, rifl - 1))
+            if kslot >= len(keycache[ck]):
+                # merged commands (batch_max_size > 1) carry the first
+                # constituent's rifl but batch_max_size x the key slots;
+                # reconstructing their keys needs the batcher's merge map
+                raise ValueError(
+                    "order diagnostics do not support client-side batching"
+                    f" (result kslot {kslot} exceeds the workload's"
+                    f" {len(keycache[ck])} keys per command)"
+                )
+            key = int(keycache[ck][kslot])
+            orders.setdefault(key, [[] for _ in range(n)])[p].append(ck)
+    return orders
+
+
+def explain_order_divergence(st: SimState, workload, env: Env) -> str:
+    """Render the exact per-key order diff across replicas — what the
+    reference prints when `ExecutionOrderMonitor`s disagree
+    (fantoch_ps/src/protocol/mod.rs:787-871). Empty string = all replicas
+    agree on every key."""
+    orders = execution_orders(st, workload, env)
+    lines = []
+    for key in sorted(orders):
+        per_proc = orders[key]
+        base = per_proc[0]
+        for p, seq in enumerate(per_proc[1:], start=1):
+            if seq == base:
+                continue
+            at = next(
+                (i for i, (a, b) in enumerate(zip(base, seq)) if a != b),
+                min(len(base), len(seq)),
+            )
+            lines.append(
+                f"key {key}: process 0 and process {p} diverge at "
+                f"position {at}:\n"
+                f"  p0 [{at}:]: {base[at:at + 6]}\n"
+                f"  p{p} [{at}:]: {seq[at:at + 6]}"
+            )
+    return "\n".join(lines)
+
+
 def protocol_metrics(st: SimState, pdef: ProtocolDef) -> Dict[str, np.ndarray]:
     if pdef.metrics is None:
         return {}

@@ -56,7 +56,7 @@ from ..protocols.common.sharding import key_shard
 from .ready import (
     ReadyRing,
     kv_apply_batch,
-    mult_powers,
+    order_hash_batch,
     ready_capacity,
     ready_drain,
     ready_init,
@@ -235,28 +235,14 @@ def make_executor(
                 key_shard(key_e, shards) == ctx.env.shard_of[ctx.pid]
             )
         # Per-key aggregates via [E, E] pair matrices + O(E) scatters — never
-        # a tensor over the key space (zipf key spaces reach ~1M keys)
+        # a tensor over the key space (zipf key spaces reach ~1M keys);
+        # rolling order hashes, KVS last-write-wins, per-entry returned
+        # values and ready-ring appends all use the shared batch helpers
+        # (executors/ready.py)
         K = est.kvs.shape[1]
-        before = e_iota[:, None] > e_iota[None, :]  # [E, E'] e' earlier
-        samekey = key_e[:, None] == key_e[None, :]
-        own_col = owned_e[None, :]
-        c_e = (before & samekey & own_col).sum(axis=1)  # occurrence index
-        m_of_e = (samekey & own_col).sum(axis=1)  # batch entries on e's key
-        scat = jnp.where(owned_e, key_e, K)  # K = dropped
-        m_k = jnp.zeros((K,), jnp.int32).at[scat].add(1, mode="drop")
-        # rolling hash: oh'_k = oh_k * M^m_k + sum_e (slot_e+1) * M^(m_k-1-c_e)
-        # (uint32 wraps = the int32 state's two's-complement wraps)
-        pow_tab = jnp.asarray(mult_powers(E + 1), jnp.uint32)
-        term_e = (s_of_e + 1).astype(jnp.uint32) * pow_tab[
-            jnp.clip(m_of_e - 1 - c_e, 0, E)
-        ]
-        add_k = jnp.zeros((K,), jnp.uint32).at[scat].add(term_e, mode="drop")
-        oh_row = (
-            est.order_hash[p].astype(jnp.uint32) * pow_tab[jnp.clip(m_k, 0, E)]
-            + add_k
-        ).astype(jnp.int32)
-        # KVS last-write-wins + per-entry returned values + ready-ring append
-        # (shared batch helpers, executors/ready.py)
+        oh_row, m_k = order_hash_batch(
+            est.order_hash[p], e_iota, key_e, s_of_e, owned_e, K
+        )
         wid_e = writer_id(client_e, rifl_e)  # [E]
         kvs_row, old_e = kv_apply_batch(
             est.kvs[p], e_iota, key_e, wid_e, owned_e & wr_e, K

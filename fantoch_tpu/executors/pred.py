@@ -34,7 +34,7 @@ from ..protocols.common.mhist import hist_init
 from .ready import (
     ReadyRing,
     kv_apply_batch,
-    mult_powers,
+    order_hash_batch,
     ready_capacity,
     ready_drain,
     ready_init,
@@ -102,7 +102,6 @@ def make_executor(n: int, max_seq: int, execute_at_commit: bool = False) -> Exec
         K = est.kvs.shape[1]
         E = DOTS * KPC
         e_iota = jnp.arange(E, dtype=jnp.int32)
-        pow_tab = jnp.asarray(mult_powers(E + 1), jnp.uint32)
         big = jnp.int32(2**30)
         est = est._replace(
             chain_max=est.chain_max.at[p].max(_ready_set(est, p).sum())
@@ -133,24 +132,9 @@ def make_executor(n: int, max_seq: int, execute_at_commit: bool = False) -> Exec
             rifl_e = ctx.cmds.rifl_seq[s_of_e]
             wid_e = writer_id(client_e, rifl_e)
             wr_e = valid_e & ~ctx.cmds.read_only[s_of_e]
-            before = e_iota[:, None] > e_iota[None, :]
-            samekey = key_e[:, None] == key_e[None, :]
-            own_col = valid_e[None, :]
-            c_e = (before & samekey & own_col).sum(axis=1)
-            m_of_e = (samekey & own_col).sum(axis=1)
-            scat = jnp.where(valid_e, key_e, K)
-            m_k = jnp.zeros((K,), jnp.int32).at[scat].add(1, mode="drop")
-            term_e = (s_of_e + 1).astype(jnp.uint32) * pow_tab[
-                jnp.clip(m_of_e - 1 - c_e, 0, E)
-            ]
-            add_k = jnp.zeros((K,), jnp.uint32).at[scat].add(
-                term_e, mode="drop"
+            oh_row, m_k = order_hash_batch(
+                e.order_hash[p], e_iota, key_e, s_of_e, valid_e, K
             )
-            oh_row = (
-                e.order_hash[p].astype(jnp.uint32)
-                * pow_tab[jnp.clip(m_k, 0, E)]
-                + add_k
-            ).astype(jnp.int32)
             kvs_row, old_e = kv_apply_batch(
                 e.kvs[p], e_iota, key_e, wid_e, wr_e, K
             )

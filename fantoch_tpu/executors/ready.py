@@ -95,6 +95,33 @@ def ready_push(ring: ReadyRing, p, client, rifl_seq, enable=True, kslot=0,
     )
 
 
+def order_hash_batch(oh_row, e_iota, key_e, s_of_e, valid_e, K: int):
+    """Fold one ordered execution batch into the per-key rolling order
+    hashes in closed form: oh'_k = oh_k * M^m_k + sum_e (slot_e+1) *
+    M^(m_k-1-c_e), where c_e is entry e's occurrence index within its key
+    and m_k the batch's entries on key k. uint32 wraps = the int32 state's
+    two's-complement wraps. Returns (new_oh_row int32, m_k int32)."""
+    import jax.numpy as jnp
+
+    E = e_iota.shape[0]
+    before = e_iota[:, None] > e_iota[None, :]
+    samekey = key_e[:, None] == key_e[None, :]
+    own_col = valid_e[None, :]
+    c_e = (before & samekey & own_col).sum(axis=1)
+    m_of_e = (samekey & own_col).sum(axis=1)
+    scat = jnp.where(valid_e, key_e, K)  # K = dropped
+    m_k = jnp.zeros((K,), jnp.int32).at[scat].add(1, mode="drop")
+    pow_tab = jnp.asarray(mult_powers(E + 1), jnp.uint32)
+    term_e = (s_of_e + 1).astype(jnp.uint32) * pow_tab[
+        jnp.clip(m_of_e - 1 - c_e, 0, E)
+    ]
+    add_k = jnp.zeros((K,), jnp.uint32).at[scat].add(term_e, mode="drop")
+    new_row = (
+        oh_row.astype(jnp.uint32) * pow_tab[jnp.clip(m_k, 0, E)] + add_k
+    ).astype(jnp.int32)
+    return new_row, m_k
+
+
 def kv_apply_batch(kvs_row, e_iota, key_e, wid_e, wr_e, K: int):
     """Apply one ordered batch of key-entries to a KVS row: last-write-wins
     per key, and each entry's returned value is the previous same-key write

@@ -842,53 +842,80 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         L = body(L._replace(cont=jnp.bool_(True)))
         return jax.lax.while_loop(lambda L: L.cont, body, L)
 
-    def fire_periodic(L: Local, myrow) -> Local:
-        for k in range(NPER):
-            due = L.st.per_next[0, k] <= L.st.now
-            L = L._replace(
-                st=L.st._replace(
-                    per_next=L.st.per_next.at[0, k].add(
-                        jnp.where(due, interval_arr[k], 0)
-                    ),
-                    step=L.st.step.at[0].add(due.astype(jnp.int32)),
-                )
+    def fire_periodic_one(L: Local, myrow, k_star) -> Local:
+        """Fire slot `k_star` on this device if due — one slot per call, the
+        canonical same-instant discipline shared with the engine
+        (lockstep.py _fire_periodic) and the native oracles: messages drain
+        first, the lowest due slot fires everywhere, its cascades drain,
+        then the next due slot."""
+        due_k = L.st.per_next[0] <= L.st.now  # [NPER]
+        due = (due_k & (jnp.arange(NPER) == k_star)).any()
+        L = L._replace(
+            st=L.st._replace(
+                per_next=L.st.per_next.at[0].add(
+                    jnp.where(
+                        (jnp.arange(NPER) == k_star) & due, interval_arr, 0
+                    )
+                ),
+                step=L.st.step.at[0].add(due.astype(jnp.int32)),
             )
-            envv = local_env_view(myrow)
+        )
+        envv = local_env_view(myrow)
+
+        def branch_proto(L, due, k):
+            ctx = _ctx(L.st, envv, myrow)
+            pst, outbox = pdef.periodic(
+                ctx, L.st.proto, jnp.int32(0),
+                spec.proto_periodic_kinds[k], L.st.now,
+            )
+            pst = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(due, a, b), pst, L.st.proto
+            )
+            L = L._replace(st=L.st._replace(proto=pst))
+            return send_outbox(
+                L, myrow, outbox._replace(valid=outbox.valid & due)
+            )
+
+        def branch_notify(L, due):
+            ctx = _ctx(L.st, envv, myrow)
+            estate, info = exdef.executed(ctx, L.st.exec, jnp.int32(0))
+            estate = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(due, a, b), estate, L.st.exec
+            )
+            L = L._replace(st=L.st._replace(exec=estate))
+            ctx = _ctx(L.st, envv, myrow)
+            pst, outbox = pdef.handle_executed(
+                ctx, L.st.proto, jnp.int32(0), info, L.st.now
+            )
+            pst = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(due, a, b), pst, L.st.proto
+            )
+            L = L._replace(st=L.st._replace(proto=pst))
+            return send_outbox(
+                L, myrow, outbox._replace(valid=outbox.valid & due)
+            )
+
+        def branch_cleanup(L, due):
+            ctx = _ctx(L.st, envv, myrow)
+            estate, res = exdef.drain(ctx, L.st.exec, jnp.int32(0))
+            estate = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(due, a, b), estate, L.st.exec
+            )
+            L = L._replace(st=L.st._replace(exec=estate))
+            return route_results(
+                L, myrow, res._replace(valid=res.valid & due)
+            )
+
+        # per-slot gating: all slot bodies run (k_star is traced), each
+        # masked by "k_star selects me AND I am due"
+        for k in range(NPER):
+            sel = due & (k_star == k)
             if k < len(spec.proto_periodic_kinds):
-                ctx = _ctx(L.st, envv, myrow)
-                pst, outbox = pdef.periodic(
-                    ctx, L.st.proto, jnp.int32(0),
-                    spec.proto_periodic_kinds[k], L.st.now,
-                )
-                pst = jax.tree_util.tree_map(
-                    lambda a, b: jnp.where(due, a, b), pst, L.st.proto
-                )
-                L = L._replace(st=L.st._replace(proto=pst))
-                L = send_outbox(L, myrow, outbox._replace(valid=outbox.valid & due))
+                L = branch_proto(L, sel, k)
             elif exec_notify_slot is not None and k == exec_notify_slot:
-                ctx = _ctx(L.st, envv, myrow)
-                estate, info = exdef.executed(ctx, L.st.exec, jnp.int32(0))
-                estate = jax.tree_util.tree_map(
-                    lambda a, b: jnp.where(due, a, b), estate, L.st.exec
-                )
-                L = L._replace(st=L.st._replace(exec=estate))
-                ctx = _ctx(L.st, envv, myrow)
-                pst, outbox = pdef.handle_executed(
-                    ctx, L.st.proto, jnp.int32(0), info, L.st.now
-                )
-                pst = jax.tree_util.tree_map(
-                    lambda a, b: jnp.where(due, a, b), pst, L.st.proto
-                )
-                L = L._replace(st=L.st._replace(proto=pst))
-                L = send_outbox(L, myrow, outbox._replace(valid=outbox.valid & due))
-            else:  # executor cleanup tick
-                ctx = _ctx(L.st, envv, myrow)
-                estate, res = exdef.drain(ctx, L.st.exec, jnp.int32(0))
-                estate = jax.tree_util.tree_map(
-                    lambda a, b: jnp.where(due, a, b), estate, L.st.exec
-                )
-                L = L._replace(st=L.st._replace(exec=estate))
-                L = route_results(L, myrow, res._replace(valid=res.valid & due))
+                L = branch_notify(L, sel)
+            else:
+                L = branch_cleanup(L, sel)
         return L
 
     def quantum(L: Local, myrow) -> Local:
@@ -897,10 +924,23 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         t_local = jnp.minimum(t_inbox, st.per_next[0].min())
         now = jax.lax.pmin(t_local, AXIS)
         L = L._replace(st=st._replace(now=now))
-        # pool messages first (engine tie rule), then periodic, then cascades
+        # pool messages first (engine tie rule); then one due slot at a
+        # time, draining cascades between (globally agreed lowest slot)
         L = subrounds(L, myrow)
-        L = fire_periodic(L, myrow)
-        L = subrounds(L, myrow)
+
+        def per_due(L):
+            due_k = L.st.per_next[0] <= L.st.now  # [NPER]
+            return jax.lax.pmax(due_k, AXIS)  # replicated
+
+        def per_body(L):
+            gdue = per_due(L)
+            k_star = jnp.argmax(gdue).astype(jnp.int32)
+            L = fire_periodic_one(L, myrow, k_star)
+            L = subrounds(L, myrow)
+            return L._replace(cont=per_due(L).any())
+
+        L = L._replace(cont=per_due(L).any())
+        L = jax.lax.while_loop(lambda L: L.cont, per_body, L)
         # replicated bookkeeping
         st = L.st
         present = lenv.cl_present[myrow]

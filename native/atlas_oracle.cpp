@@ -724,42 +724,51 @@ struct AtlasSim {
     }
   }
 
-  void fire_periodic() {
-    // slots in engine order: 0 = protocol GC, 1 = executed notification,
-    // 2 = executor cleanup; all due processes fire per slot, candidates
-    // are sequenced after the whole batch (engine _fire_periodic)
+  // Fire the LOWEST due periodic slot for every due process (slots:
+  // 0 = protocol GC, 1 = executed notification, 2 = executor cleanup) —
+  // the canonical same-instant discipline shared with the engine
+  // (lockstep.py _fire_periodic): messages drain first, one slot fires,
+  // its cascades drain, then the next due slot. Returns false if none due.
+  bool fire_periodic_one() {
     const int64_t intervals[3] = {int64_t(gc_ms), int64_t(executed_ms),
                                   int64_t(cleanup_ms)};
-    for (int k = 0; k < 3; k++) {
-      std::vector<int> due;
+    int k_star = -1;
+    for (int k = 0; k < 3 && k_star < 0; k++)
       for (int p = 0; p < n; p++)
         if (per_next[p][k] <= now) {
-          per_next[p][k] += intervals[k];
-          due.push_back(p);
-          step++;
+          k_star = k;
+          break;
         }
-      for (int p : due) {
-        if (k == 0) {
-          std::vector<int32_t> pay(2 * n);
-          for (int a = 0; a < n; a++) {
-            pay[a] = report_row(p, a);
-            pay[n + a] = stable_wm[p][a];
-          }
-          send_proto(p, ((1u << n) - 1u) & ~(1u << p), A_MGC, pay);
-        } else if (k == 1) {
-          // Executor::executed -> Protocol::handle_executed -> gc_note_exec
-          for (int a = 0; a < n; a++) {
-            int64_t old = gc_exec_fr[p][a];
-            gc_exec_fr[p][a] =
-                old == INF_TIME ? ex_frontier[p][a]
-                                : std::max(old, int64_t(ex_frontier[p][a]));
-          }
-        } else {
-          drain_and_route(p);
+    if (k_star < 0) return false;
+    std::vector<int> due;
+    for (int p = 0; p < n; p++)
+      if (per_next[p][k_star] <= now) {
+        per_next[p][k_star] += intervals[k_star];
+        due.push_back(p);
+        step++;
+      }
+    for (int p : due) {
+      if (k_star == 0) {
+        std::vector<int32_t> pay(2 * n);
+        for (int a = 0; a < n; a++) {
+          pay[a] = report_row(p, a);
+          pay[n + a] = stable_wm[p][a];
         }
+        send_proto(p, ((1u << n) - 1u) & ~(1u << p), A_MGC, pay);
+      } else if (k_star == 1) {
+        // Executor::executed -> Protocol::handle_executed -> gc_note_exec
+        for (int a = 0; a < n; a++) {
+          int64_t old = gc_exec_fr[p][a];
+          gc_exec_fr[p][a] =
+              old == INF_TIME ? ex_frontier[p][a]
+                              : std::max(old, int64_t(ex_frontier[p][a]));
+        }
+      } else {
+        drain_and_route(p);
       }
     }
     flush_cands();
+    return true;
   }
 
   void run() {
@@ -774,8 +783,7 @@ struct AtlasSim {
         for (int64_t t : row) t_per = std::min(t_per, t);
       now = std::min(t_pool, t_per);
       msg_subrounds();
-      fire_periodic();
-      msg_subrounds();
+      while (fire_periodic_one()) msg_subrounds();
       bool was_done = all_done;
       all_done = clients_done >= C;
       if (all_done && !was_done) final_time = now + extra_ms;

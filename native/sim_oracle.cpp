@@ -258,21 +258,34 @@ struct Sim {
   }
 
   void periodic_fire() {
-    // argmin over [n, NPER] row-major, first occurrence (lockstep.py)
-    int bp = 0, bk = 0;
-    int64_t bt = INF_TIME + 1;
+    // fire the LOWEST due slot for every due process, process-major — the
+    // canonical same-instant discipline (lockstep.py _fire_periodic): the
+    // caller drains messages first and cascades between slot firings
     const int nper = int(per_interval.size());
+    int k_star = -1;
+    for (int k = 0; k < nper && k_star < 0; k++)
+      for (int p = 0; p < n; p++)
+        if (per_next[p][k] <= now) {
+          k_star = k;
+          break;
+        }
+    if (k_star < 0) return;
+    std::vector<int> due;
     for (int p = 0; p < n; p++)
-      for (int k = 0; k < nper; k++)
-        if (per_next[p][k] < bt) bt = per_next[p][k], bp = p, bk = k;
-    per_next[bp][bk] += per_interval[bk];
-    if (bk == 0) {
-      // GarbageCollection broadcast (basic.py periodic)
-      std::vector<int32_t> row(gc_frontier.begin() + bp * n,
-                               gc_frontier.begin() + (bp + 1) * n);
-      send_proto(bp, ((1 << n) - 1) & ~(1 << bp), MGC, row);
-    } else {
-      drain_and_route(bp);  // executor cleanup tick
+      if (per_next[p][k_star] <= now) {
+        per_next[p][k_star] += per_interval[k_star];
+        due.push_back(p);
+        step++;
+      }
+    for (int p : due) {
+      if (k_star == 0) {
+        // GarbageCollection broadcast (basic.py periodic)
+        std::vector<int32_t> row(gc_frontier.begin() + p * n,
+                                 gc_frontier.begin() + (p + 1) * n);
+        send_proto(p, ((1 << n) - 1) & ~(1 << p), MGC, row);
+      } else {
+        drain_and_route(p);  // executor cleanup tick
+      }
     }
   }
 
@@ -291,8 +304,8 @@ struct Sim {
       for (auto& row : per_next)
         for (int64_t t : row) t_per = std::min(t_per, t);
       now = std::min(t_pool, t_per);
-      step++;
       if (t_pool <= t_per) {
+        step++;
         Event ev = pool.top();
         pool.pop();
         switch (ev.kind) {
@@ -301,7 +314,7 @@ struct Sim {
           default: handle_proto(ev); break;
         }
       } else {
-        periodic_fire();
+        periodic_fire();  // counts one step per fired process
       }
     }
   }
@@ -524,17 +537,29 @@ struct FpaxosSim {
   }
 
   void periodic_fire() {
-    int bp = 0, bk = 0;
-    int64_t bt = INF_TIME + 1;
+    // lowest due slot for every due process, process-major (see Sim above)
     const int nper = int(per_interval.size());
+    int k_star = -1;
+    for (int k = 0; k < nper && k_star < 0; k++)
+      for (int p = 0; p < n; p++)
+        if (per_next[p][k] <= now) {
+          k_star = k;
+          break;
+        }
+    if (k_star < 0) return;
+    std::vector<int> due;
     for (int p = 0; p < n; p++)
-      for (int k = 0; k < nper; k++)
-        if (per_next[p][k] < bt) bt = per_next[p][k], bp = p, bk = k;
-    per_next[bp][bk] += per_interval[bk];
-    if (bk == 0) {
-      send_proto(bp, ((1 << n) - 1) & ~(1 << bp), FP_MGC, {frontier[bp]});
-    } else {
-      drain_and_route(bp);
+      if (per_next[p][k_star] <= now) {
+        per_next[p][k_star] += per_interval[k_star];
+        due.push_back(p);
+        step++;
+      }
+    for (int p : due) {
+      if (k_star == 0) {
+        send_proto(p, ((1 << n) - 1) & ~(1 << p), FP_MGC, {frontier[p]});
+      } else {
+        drain_and_route(p);
+      }
     }
   }
 
@@ -548,8 +573,8 @@ struct FpaxosSim {
       for (auto& row : per_next)
         for (int64_t t : row) t_per = std::min(t_per, t);
       now = std::min(t_pool, t_per);
-      step++;
       if (t_pool <= t_per) {
+        step++;
         Event ev = pool.top();
         pool.pop();
         switch (ev.kind) {
@@ -558,7 +583,7 @@ struct FpaxosSim {
           default: handle_proto(ev); break;
         }
       } else {
-        periodic_fire();
+        periodic_fire();  // counts one step per fired process
       }
     }
   }
