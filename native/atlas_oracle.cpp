@@ -126,6 +126,7 @@ struct AtlasSim {
   // ---- engine state ----
   std::vector<Msg> pool;
   int64_t now = 0, step = 0, seqno = 0;
+  std::vector<int64_t> src_seq;  // [n+C] fast-contract tie-key counters
   std::vector<std::vector<int64_t>> per_next;  // [n][3] gc/executed/cleanup
   bool all_done = false;
   int64_t final_time = INF_TIME;
@@ -173,7 +174,9 @@ struct AtlasSim {
   std::vector<std::vector<int32_t>> kvs;            // [n][K]
 
   void init() {
-    per_next.assign(n, {int64_t(gc_ms), int64_t(executed_ms), int64_t(cleanup_ms)});
+    per_next.assign(n, {int64_t(gc_ms), int64_t(executed_ms),
+                        // fast contract: the cleanup tick never fires
+                        reorder_hash ? int64_t(cleanup_ms) : INF_TIME});
     cmd_tab.assign(size_t(n) * W, {});
     next_seq.assign(n, 1);
     c_start.assign(C, 0);
@@ -206,14 +209,18 @@ struct AtlasSim {
     ready_pop.assign(n, 0);
     kvs.assign(n, std::vector<int32_t>(key_space, 0));
 
-    // initial closed-loop submits: slot c gets sequence number c
+    // initial closed-loop submits: slot c gets sequence number c (exact
+    // contract) or the (gsrc = n + c, seq 0) fast-contract tie key
+    src_seq.assign(n + C, 0);
     for (int c = 0; c < C; c++) {
       int64_t t = dist_cp[c];
       if (reorder_hash) t = t * hash_mult_x10(uint32_t(c), salt) / 10;
       std::vector<int32_t> pay = {c, 1, wl_ro[size_t(c) * cmds + 0]};
       for (int k = 0; k < kpc; k++)
         pay.push_back(wl_keys[(size_t(c) * cmds + 0) * kpc + k]);
-      pool.push_back(Msg{t, c, c, client_proc[c], KIND_SUBMIT, pay});
+      int64_t s = reorder_hash ? c : (int64_t(n + c) * (1 << 24));
+      src_seq[n + c] = 1;
+      pool.push_back(Msg{t, s, c, client_proc[c], KIND_SUBMIT, pay});
     }
     seqno = C;
   }
@@ -226,6 +233,12 @@ struct AtlasSim {
     int64_t s = seqno++;
     if (net && reorder_hash)
       base = base * hash_mult_x10(uint32_t(s), salt) / 10;
+    if (!reorder_hash) {
+      // fast-contract tie key (see sim_oracle.cpp Event::seq)
+      int gsrc = (kind == KIND_SUBMIT ? n + src : src);
+      s = int64_t(gsrc) * (1 << 24) +
+          std::min<int64_t>(src_seq[gsrc]++, (1 << 24) - 1);
+    }
     pool.push_back(Msg{now + base, s, src, dst, kind, std::move(payload)});
   }
 
@@ -447,7 +460,7 @@ struct AtlasSim {
 
   // drain up to max_res ready results and route them (the engine drains
   // after every handler call and on cleanup ticks; _route_results)
-  void drain_and_route(int p) {
+  int drain_batch(int p) {
     int take = int(std::min<size_t>(ready[p].size() - ready_pop[p], size_t(max_res)));
     for (int i = 0; i < take; i++) {
       const Res& r = ready[p][ready_pop[p] + i];
@@ -461,6 +474,19 @@ struct AtlasSim {
     if (ready_pop[p] == ready[p].size()) {
       ready[p].clear();
       ready_pop[p] = 0;
+    }
+    return take;
+  }
+
+  void drain_and_route(int p) {
+    if (reorder_hash) {
+      drain_batch(p);  // exact contract: bounded drain + cleanup ticks
+      return;
+    }
+    // fast contract: results emit at the instant they become ready — the
+    // engine drains max_res per acting row and retries full drains at the
+    // same instant (lockstep.py `drain_pend`)
+    while (drain_batch(p) == max_res) {
     }
   }
 
@@ -732,8 +758,10 @@ struct AtlasSim {
   bool fire_periodic_one() {
     const int64_t intervals[3] = {int64_t(gc_ms), int64_t(executed_ms),
                                   int64_t(cleanup_ms)};
+    // fast contract: no cleanup tick (slot 2) — results drain at readiness
+    const int nslots = reorder_hash ? 3 : 2;
     int k_star = -1;
-    for (int k = 0; k < 3 && k_star < 0; k++)
+    for (int k = 0; k < nslots && k_star < 0; k++)
       for (int p = 0; p < n; p++)
         if (per_next[p][k] <= now) {
           k_star = k;

@@ -41,7 +41,13 @@ constexpr int MGC = 3;
 
 struct Event {
   int64_t time;
-  int64_t seq;  // insertion order, the deterministic tie-break
+  // same-(destination, time) tie-break. The engine's plain ("fast") loop
+  // orders ties by the schedule-independent key gsrc * 2^24 + per-source
+  // emission count (lockstep.py _insert, FAST branch; gsrc = process index,
+  // or n + client index) — the same (src, seq) discipline the distributed
+  // runner uses (parallel/quantum.py `deliverables`). Both oracles below
+  // compute the identical key in push_event.
+  int64_t seq;
   int32_t src, dst, kind;
   std::vector<int32_t> payload;
 };
@@ -67,7 +73,8 @@ struct Sim {
 
   // ---- engine state ----
   std::priority_queue<Event, std::vector<Event>, EventOrder> pool;
-  int64_t now = 0, step = 0, seqno = 0;
+  int64_t now = 0, step = 0;
+  std::vector<int64_t> src_seq;  // [n+C] per-source emission counters
   std::vector<std::vector<int64_t>> per_next;  // [n][NPER]
   bool all_done = false;
   int64_t final_time = INF_TIME;
@@ -103,7 +110,10 @@ struct Sim {
 
   void push_event(int64_t time, int src, int dst, int kind,
                   std::vector<int32_t> payload) {
-    pool.push(Event{time, seqno++, src, dst, kind, std::move(payload)});
+    int gsrc = (kind == KIND_SUBMIT ? n + src : src);
+    int64_t seq = int64_t(gsrc) * (1 << 24) +
+                  std::min<int64_t>(src_seq[gsrc]++, (1 << 24) - 1);
+    pool.push(Event{time, seq, src, dst, kind, std::move(payload)});
   }
 
   // protocol broadcast: engine candidate order is dst = 0..n-1
@@ -156,7 +166,7 @@ struct Sim {
   }
 
   // lockstep.py _route_results: drain up to max_res, emit completions
-  void drain_and_route(int p) {
+  int drain_batch(int p) {
     int take = int(std::min<size_t>(ready[p].size() - ready_pop[p], max_res));
     std::vector<std::pair<int32_t, int32_t>> batch;
     for (int i = 0; i < take; i++) batch.push_back(ready[p][ready_pop[p] + i]);
@@ -175,6 +185,16 @@ struct Sim {
         if (batch[j].first == c) is_last = false;
       if (complete && is_last)
         push_event(now + dist_pc[p * C + c], p, c, KIND_TO_CLIENT, {c, rifl});
+    }
+    return take;
+  }
+
+  // fast-contract drain: results emit at the instant they become ready —
+  // the engine drains max_res after every acting row and retries full
+  // drains at the same instant (lockstep.py `drain_pend`), so the oracle
+  // drains batches until one comes back short
+  void drain_and_route(int p) {
+    while (drain_batch(p) == max_res) {
     }
   }
 
@@ -278,14 +298,12 @@ struct Sim {
         step++;
       }
     for (int p : due) {
-      if (k_star == 0) {
-        // GarbageCollection broadcast (basic.py periodic)
-        std::vector<int32_t> row(gc_frontier.begin() + p * n,
-                                 gc_frontier.begin() + (p + 1) * n);
-        send_proto(p, ((1 << n) - 1) & ~(1 << p), MGC, row);
-      } else {
-        drain_and_route(p);  // executor cleanup tick
-      }
+      // GarbageCollection broadcast (basic.py periodic); the executor
+      // cleanup tick does not exist under the fast contract (results
+      // drain at readiness, see drain_and_route)
+      std::vector<int32_t> row(gc_frontier.begin() + p * n,
+                               gc_frontier.begin() + (p + 1) * n);
+      send_proto(p, ((1 << n) - 1) & ~(1 << p), MGC, row);
     }
   }
 
@@ -346,7 +364,8 @@ struct FpaxosSim {
   std::vector<int64_t> per_interval;
 
   std::priority_queue<Event, std::vector<Event>, EventOrder> pool;
-  int64_t now = 0, step = 0, seqno = 0;
+  int64_t now = 0, step = 0;
+  std::vector<int64_t> src_seq;  // [n+C] per-source emission counters
   std::vector<std::vector<int64_t>> per_next;
   bool all_done = false;
   int64_t final_time = INF_TIME;
@@ -381,7 +400,10 @@ struct FpaxosSim {
 
   void push_event(int64_t time, int src, int dst, int kind,
                   std::vector<int32_t> payload) {
-    pool.push(Event{time, seqno++, src, dst, kind, std::move(payload)});
+    int gsrc = (kind == KIND_SUBMIT ? n + src : src);
+    int64_t seq = int64_t(gsrc) * (1 << 24) +
+                  std::min<int64_t>(src_seq[gsrc]++, (1 << 24) - 1);
+    pool.push(Event{time, seq, src, dst, kind, std::move(payload)});
   }
 
   void send_proto(int src, int32_t tgt_mask, int proto_kind,
@@ -392,7 +414,7 @@ struct FpaxosSim {
                    KIND_PROTO_BASE + proto_kind, payload);
   }
 
-  void drain_and_route(int p) {
+  int drain_batch(int p) {
     int take = int(std::min<size_t>(ready[p].size() - ready_pop[p], max_res));
     std::vector<std::pair<int32_t, int32_t>> batch;
     for (int i = 0; i < take; i++) batch.push_back(ready[p][ready_pop[p] + i]);
@@ -411,6 +433,12 @@ struct FpaxosSim {
         if (batch[j].first == c) is_last = false;
       if (complete && is_last)
         push_event(now + dist_pc[p * C + c], p, c, KIND_TO_CLIENT, {c, rifl});
+    }
+    return take;
+  }
+
+  void drain_and_route(int p) {  // fast contract (see Sim::drain_and_route)
+    while (drain_batch(p) == max_res) {
     }
   }
 
@@ -555,11 +583,7 @@ struct FpaxosSim {
         step++;
       }
     for (int p : due) {
-      if (k_star == 0) {
-        send_proto(p, ((1 << n) - 1) & ~(1 << p), FP_MGC, {frontier[p]});
-      } else {
-        drain_and_route(p);
-      }
+      send_proto(p, ((1 << n) - 1) & ~(1 << p), FP_MGC, {frontier[p]});
     }
   }
 
@@ -610,8 +634,11 @@ int sim_basic(int n, int C, int kpc, int max_seq, int commands_per_client,
   s.max_steps = max_steps;
   s.dist_pp = dist_pp; s.dist_pc = dist_pc; s.dist_cp = dist_cp;
   s.client_proc = client_proc; s.fq_mask = fq_mask;
-  s.per_interval = {gc_interval_ms, cleanup_ms};
-  s.per_next.assign(n, {int64_t(gc_interval_ms), int64_t(cleanup_ms)});
+  (void)cleanup_ms;  // fast contract: no cleanup tick (results drain at
+                     // readiness; parameter kept for ABI stability)
+  s.per_interval = {gc_interval_ms};
+  s.per_next.assign(n, {int64_t(gc_interval_ms)});
+  s.src_seq.assign(n + C, 0);
   int D = s.dots();
   s.next_seq.assign(n, 1);
   s.cmd_client.assign(D, 0); s.cmd_rifl.assign(D, 0);
@@ -656,8 +683,10 @@ int sim_fpaxos(int n, int C, int kpc, int max_seq, int commands_per_client,
   s.max_steps = max_steps;
   s.dist_pp = dist_pp; s.dist_pc = dist_pc; s.dist_cp = dist_cp;
   s.client_proc = client_proc; s.wq_mask = wq_mask;
-  s.per_interval = {gc_interval_ms, cleanup_ms};
-  s.per_next.assign(n, {int64_t(gc_interval_ms), int64_t(cleanup_ms)});
+  (void)cleanup_ms;  // fast contract: no cleanup tick
+  s.per_interval = {gc_interval_ms};
+  s.per_next.assign(n, {int64_t(gc_interval_ms)});
+  s.src_seq.assign(n + C, 0);
   int D = s.slots();
   s.next_seq.assign(n, 1);
   s.cmd_client.assign(D, 0); s.cmd_rifl.assign(D, 0);

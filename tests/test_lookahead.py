@@ -1,0 +1,115 @@
+"""Lookahead-vs-exact engine equivalence.
+
+The plain-mode engine runs the conservative-lookahead loop
+(`engine/lockstep.py _fast_round`): every zero-distance component advances
+through its own next instant per trip, gated by min-plus shortest-path
+horizons (Chandy-Misra-Bryant lookahead over the static link matrix). The
+reorder modes — and `FANTOCH_EXACT=1` — run the exact global-instant
+lock-step loop instead.
+
+These tests pin the central safety claim: the schedule is unobservable.
+Latency histograms, counts and protocol counters must be IDENTICAL between
+the two loops (the only permitted divergences are same-(destination, time)
+tie orders, which these protocols do not expose in latency space, and which
+the cross-replica order-hash assertions in the oracle tests cover). The mix
+below deliberately includes the two shapes that broke draft versions of the
+lookahead: open-loop clients (pending self-ticks let an unsound horizon run
+a client past an in-flight reply — caught as a 3x latency inflation) and
+colocated 0 ms client/process pairs (component fallback discipline).
+"""
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.planet import Planet
+from fantoch_tpu.core.workload import KeyGen, Workload
+from fantoch_tpu.engine import lockstep, setup
+
+
+def run_once(proto_mod, *, exact, open_loop=False, n=3, f=1, cmds=10,
+             window=None, seed=0):
+    planet = Planet.new()
+    config = Config(n=n, f=f, gc_interval_ms=20,
+                    executor_executed_notification_interval_ms=25)
+    wl = Workload(1, KeyGen.conflict_pool(50, 2), 1, cmds, 100)
+    pdef = proto_mod.make_protocol(n, 1)
+    placement = setup.Placement(
+        ["asia-east1", "us-central1", "us-west1"][:n]
+        + ["europe-west2", "europe-west3"][: max(0, n - 3)],
+        # one colocated region (0 ms client-process links) + one remote
+        ["us-central1", "us-west2"],
+        3,
+    )
+    spec = setup.build_spec(
+        config, wl, pdef, n_clients=6, n_client_groups=2,
+        max_steps=5_000_000, extra_ms=1000, max_seq=window,
+        open_loop_interval_ms=40 if open_loop else None,
+    )
+    env = setup.build_env(spec, config, planet, placement, wl, pdef, seed=seed)
+    if exact:
+        os.environ["FANTOCH_EXACT"] = "1"
+    else:
+        os.environ.pop("FANTOCH_EXACT", None)
+    try:
+        st = jax.jit(lockstep.make_run(spec, pdef, wl))(env)
+    finally:
+        os.environ.pop("FANTOCH_EXACT", None)
+    return jax.tree_util.tree_map(np.asarray, st)
+
+
+CASES = [
+    ("basic", False),
+    ("basic", True),  # open loop: pending self-ticks stress the horizon
+    ("tempo", False),
+    ("atlas", False),
+]
+
+
+@pytest.mark.parametrize("proto,open_loop", CASES)
+def test_lookahead_matches_exact(proto, open_loop):
+    from fantoch_tpu.protocols import atlas, basic, tempo
+
+    mod = {"basic": basic, "tempo": tempo, "atlas": atlas}[proto]
+    window = 12 if proto != "basic" else None
+    a = run_once(mod, exact=True, open_loop=open_loop, window=window)
+    b = run_once(mod, exact=False, open_loop=open_loop, window=window)
+    assert bool(a.all_done) and bool(b.all_done)
+    assert int(b.dropped) == 0
+    np.testing.assert_array_equal(a.lat_cnt, b.lat_cnt)
+    # tie-order may legally shift a dependency wait by a tie; everything
+    # else must match exactly — allow only a tiny per-client tolerance for
+    # the dep-graph protocol, zero for the rest
+    if proto == "atlas":
+        np.testing.assert_allclose(a.lat_sum, b.lat_sum, atol=2)
+    else:
+        np.testing.assert_array_equal(a.lat_sum, b.lat_sum)
+        np.testing.assert_array_equal(a.hist, b.hist)
+    # the lookahead loop must actually look ahead (fewer trips), not just
+    # agree by degenerating to the exact schedule
+    assert int(b.iters) < int(a.iters)
+
+
+def test_row_schedules_agree():
+    """The vmapped row schedule (what the TPU runs) must produce EXACTLY the
+    row-loop schedule's results (what every CPU test exercises) — the link
+    the on-device golden check in bench.py builds on: row-loop CPU == vmap
+    CPU here, vmap CPU == vmap TPU there."""
+    from fantoch_tpu.protocols import tempo
+
+    def run(row_loop):
+        os.environ["FANTOCH_ROW_LOOP"] = "1" if row_loop else "0"
+        try:
+            return run_once(tempo, exact=False, window=12)
+        finally:
+            os.environ.pop("FANTOCH_ROW_LOOP", None)
+
+    a = run(True)
+    b = run(False)
+    np.testing.assert_array_equal(a.lat_sum, b.lat_sum)
+    np.testing.assert_array_equal(a.lat_cnt, b.lat_cnt)
+    np.testing.assert_array_equal(a.hist, b.hist)
+    np.testing.assert_array_equal(a.exec.order_hash, b.exec.order_hash)
+    assert int(a.step) == int(b.step) and int(a.iters) == int(b.iters)
