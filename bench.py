@@ -108,31 +108,32 @@ def build_batch(pdef, n_configs, commands_per_client, window,
 # ---------------------------------------------------------------------------
 
 _canary_fn = None
-_canary_baseline = None
 
 
 def canary(tag):
-    """Tiny fixed device program, timed. Returns (ok, ms). A degraded
-    tunneled worker fails or runs this orders of magnitude slower."""
-    global _canary_fn, _canary_baseline
+    """Tiny fixed device program, timed. Returns (ok, ms).
+
+    Purpose: catch the tunneled worker's post-fault degradation (documented
+    minutes-long state where even tiny programs fail or run 100x slow), NOT
+    to police latency — host-side CPU contention alone can add ~100ms to a
+    single dispatch round-trip while leaving real device throughput intact,
+    so the probe takes the BEST of three calls and uses a generous absolute
+    threshold. Hard faults (exceptions) are always degraded."""
+    global _canary_fn
     try:
+        x = np.ones((256, 256), np.float32)
         if _canary_fn is None:
-            x = np.ones((256, 256), np.float32)
             _canary_fn = jax.jit(lambda a: (a @ a).sum())
             jax.block_until_ready(_canary_fn(x))  # compile
+        best = float("inf")
+        for _ in range(3):
             t0 = time.time()
-            for _ in range(3):
-                jax.block_until_ready(_canary_fn(x))
-            _canary_baseline = max((time.time() - t0) / 3, 1e-4)
-        x = np.ones((256, 256), np.float32)
-        t0 = time.time()
-        jax.block_until_ready(_canary_fn(x))
-        ms = (time.time() - t0) * 1e3
-        ok = ms < max(50.0, _canary_baseline * 1e3 * 20)
+            jax.block_until_ready(_canary_fn(x))
+            best = min(best, (time.time() - t0) * 1e3)
+        ok = best < 2000.0
         if not ok:
-            log(f"  canary[{tag}]: SLOW {ms:.1f}ms "
-                f"(baseline {_canary_baseline*1e3:.1f}ms) — worker degraded")
-        return ok, ms
+            log(f"  canary[{tag}]: SLOW {best:.1f}ms — worker degraded")
+        return ok, best
     except Exception as e:  # noqa: BLE001 — any device fault means degraded
         log(f"  canary[{tag}]: ERROR {type(e).__name__}: {e}")
         return False, -1.0
@@ -274,13 +275,17 @@ def main():
     # chunk lengths keep each device call well under the tunnel's ~40s
     # stall watchdog (a tripped watchdog faults the worker and degrades
     # everything after it)
+    # windows picked as the smallest ring that never defers a submit at
+    # these client counts (event totals equal the unwindowed run's, so the
+    # measured workload is the reference's semantics); per-trip cost scales
+    # with the per-dot window state, so tighter rings are pure speedup
     runs = [
         # (name, pdef, configs, commands/client, window, chunk_steps, pool)
-        ("basic", basic_proto.make_protocol(n, 1), int(256 * scale), 100, 32,
+        ("basic", basic_proto.make_protocol(n, 1), int(256 * scale), 100, 12,
          20_000, 384),
-        ("tempo", tempo_proto.make_protocol(n, 1), int(64 * scale), 25, 32,
+        ("tempo", tempo_proto.make_protocol(n, 1), int(64 * scale), 25, 12,
          8_000, 384),
-        ("atlas", atlas_proto.make_protocol(n, 1), int(64 * scale), 25, 24,
+        ("atlas", atlas_proto.make_protocol(n, 1), int(64 * scale), 25, 12,
          8_000, 384),
     ]
     total_events, total_time = 0, 0.0

@@ -135,40 +135,17 @@ def sim_fpaxos_oracle(*, wq_size: int, leader: int, wq_mask, **kw) -> dict:
     )
 
 
-def sim_atlas_oracle(
-    *,
-    n: int,
-    n_clients: int,
-    keys_per_command: int,
-    max_seq: int,
-    commands_per_client: int,
-    variant: int,  # 0 = atlas/janus, 1 = epaxos
-    wq_size: int,
-    max_res: int,
-    extra_ms: int,
-    gc_interval_ms: int,
-    executed_ms: int,
-    cleanup_ms: int,
-    reorder_hash: bool,
-    salt: int,
-    key_space: int,
-    max_steps: int,
-    dist_pp,
-    dist_pc,
-    dist_cp,
-    client_proc,
-    fq_mask,
-    wq_mask,
-    keys,  # [C, CMDS, KPC] workload keys per (client, command index)
-    read_only,  # [C, CMDS] 0/1
-) -> dict:
-    """Run the native Atlas/EPaxos oracle: dependency-graph consensus with
-    the graph executor and windowed GC (native/atlas_oracle.cpp), under the
-    deterministic hash-reorder mode when `reorder_hash` is set. Returns
-    latencies, protocol counters, per-(process, key) execution-order hashes
-    and the clients' final returned values."""
+
+def _run_graph_oracle(symbol, *, n, n_clients, keys_per_command, max_seq,
+                      commands_per_client, proto_ints, max_res, extra_ms,
+                      gc_interval_ms, executed_ms, cleanup_ms, reorder_hash,
+                      salt, key_space, max_steps, dist_pp, dist_pc, dist_cp,
+                      client_proc, fq_mask, wq_mask, keys, read_only) -> dict:
+    """Shared marshaling for the full-protocol oracles (sim_atlas,
+    sim_tempo): identical buffer layout, differing only in the
+    protocol-specific ints spliced into iparams after the common prefix."""
     lib = load()
-    fn = lib.sim_atlas
+    fn = getattr(lib, symbol)
     fn.restype = ctypes.c_int
     C, K = n_clients, key_space
     dist_pp = _i32(dist_pp)
@@ -186,12 +163,10 @@ def sim_atlas_oracle(
     assert read_only.shape == (C, commands_per_client)
 
     iparams = _i32(
-        [
-            n, C, keys_per_command, max_seq, commands_per_client, variant,
-            wq_size, max_res, extra_ms, gc_interval_ms, executed_ms,
-            cleanup_ms, int(bool(reorder_hash)),
-            np.int32(np.uint32(salt & 0xFFFFFFFF)), K,
-        ]
+        [n, C, keys_per_command, max_seq, commands_per_client]
+        + list(proto_ints)
+        + [max_res, extra_ms, gc_interval_ms, executed_ms, cleanup_ms,
+           int(bool(reorder_hash)), np.int32(np.uint32(salt & 0xFFFFFFFF)), K]
     )
     lat_sum = np.zeros(C, np.int64)
     lat_cnt = np.zeros(C, np.int32)
@@ -220,7 +195,7 @@ def sim_atlas_oracle(
         ptr(c_vals, ctypes.c_int32), ctypes.byref(steps),
     )
     if rc != 0:
-        raise RuntimeError(f"sim_atlas oracle failed with code {rc}")
+        raise RuntimeError(f"{symbol} oracle failed with code {rc}")
     return {
         "lat_sum": lat_sum,
         "lat_cnt": lat_cnt,
@@ -233,3 +208,79 @@ def sim_atlas_oracle(
         "c_vals": c_vals,
         "steps": int(steps.value),
     }
+
+def sim_atlas_oracle(
+    *,
+    n: int,
+    n_clients: int,
+    keys_per_command: int,
+    max_seq: int,
+    commands_per_client: int,
+    variant: int,  # 0 = atlas/janus, 1 = epaxos
+    wq_size: int,
+    max_res: int,
+    extra_ms: int,
+    gc_interval_ms: int,
+    executed_ms: int,
+    cleanup_ms: int,
+    reorder_hash: bool,
+    salt: int,
+    key_space: int,
+    max_steps: int,
+    dist_pp, dist_pc, dist_cp, client_proc, fq_mask, wq_mask,
+    keys, read_only,
+) -> dict:
+    """Run the native Atlas/EPaxos oracle (native/atlas_oracle.cpp):
+    dependency-graph consensus with the graph executor and windowed GC,
+    under the deterministic hash-reorder mode when `reorder_hash` is set."""
+    return _run_graph_oracle(
+        "sim_atlas", n=n, n_clients=n_clients,
+        keys_per_command=keys_per_command, max_seq=max_seq,
+        commands_per_client=commands_per_client,
+        proto_ints=(variant, wq_size), max_res=max_res, extra_ms=extra_ms,
+        gc_interval_ms=gc_interval_ms, executed_ms=executed_ms,
+        cleanup_ms=cleanup_ms, reorder_hash=reorder_hash, salt=salt,
+        key_space=key_space, max_steps=max_steps, dist_pp=dist_pp,
+        dist_pc=dist_pc, dist_cp=dist_cp, client_proc=client_proc,
+        fq_mask=fq_mask, wq_mask=wq_mask, keys=keys, read_only=read_only,
+    )
+
+
+def sim_tempo_oracle(
+    *,
+    n: int,
+    n_clients: int,
+    keys_per_command: int,
+    max_seq: int,
+    commands_per_client: int,
+    fq_minority: int,
+    stability_threshold: int,
+    wq_size: int,
+    max_res: int,
+    extra_ms: int,
+    gc_interval_ms: int,
+    executed_ms: int,
+    cleanup_ms: int,
+    reorder_hash: bool,
+    salt: int,
+    key_space: int,
+    max_steps: int,
+    dist_pp, dist_pc, dist_cp, client_proc, fq_mask, wq_mask,
+    keys, read_only,
+) -> dict:
+    """Run the native Tempo oracle (native/tempo_oracle.cpp): timestamp
+    proposals and vote ranges, the QuorumClocks fast-path test, synod slow
+    path, eager detached votes, and the votes-table stability executor —
+    the engine-contract cross-check for the table executor."""
+    return _run_graph_oracle(
+        "sim_tempo", n=n, n_clients=n_clients,
+        keys_per_command=keys_per_command, max_seq=max_seq,
+        commands_per_client=commands_per_client,
+        proto_ints=(fq_minority, stability_threshold, wq_size),
+        max_res=max_res, extra_ms=extra_ms, gc_interval_ms=gc_interval_ms,
+        executed_ms=executed_ms, cleanup_ms=cleanup_ms,
+        reorder_hash=reorder_hash, salt=salt, key_space=key_space,
+        max_steps=max_steps, dist_pp=dist_pp, dist_pc=dist_pc,
+        dist_cp=dist_cp, client_proc=client_proc, fq_mask=fq_mask,
+        wq_mask=wq_mask, keys=keys, read_only=read_only,
+    )

@@ -810,6 +810,9 @@ struct AtlasSim {
       for (auto& row : per_next)
         for (int64_t t : row) t_per = std::min(t_per, t);
       now = std::min(t_pool, t_per);
+      // the engine's loop guard reads the advanced clock BEFORE processing
+      // the next instant, so nothing past final_time ever runs
+      if (all_done && now > final_time) break;
       msg_subrounds();
       while (fire_periodic_one()) msg_subrounds();
       bool was_done = all_done;

@@ -322,6 +322,7 @@ struct Sim {
       for (auto& row : per_next)
         for (int64_t t : row) t_per = std::min(t_per, t);
       now = std::min(t_pool, t_per);
+      if (all_done && now > final_time) break;
       if (t_pool <= t_per) {
         step++;
         Event ev = pool.top();
@@ -597,6 +598,7 @@ struct FpaxosSim {
       for (auto& row : per_next)
         for (int64_t t : row) t_per = std::min(t_per, t);
       now = std::min(t_pool, t_per);
+      if (all_done && now > final_time) break;
       if (t_pool <= t_per) {
         step++;
         Event ev = pool.top();

@@ -162,21 +162,16 @@ FPAXOS_CASES = [
 ]
 
 
-def run_both_atlas(variant, n, f, pregions, cregions, cpr, cmds, window,
-                   conflict, read_only_pct, reorder_hash, seed=0):
-    """Atlas/EPaxos engine vs the native dependency-graph oracle
-    (native/atlas_oracle.cpp): the hardest kernels — per-key dep collection,
-    quorum fast-path checks, synod slow path, the graph executor's
-    SCC-ready ordering and windowed GC compaction — cross-checked against an
-    independent map-based C++ implementation, optionally under the
-    deterministic hash-reorder mode."""
+
+def _run_graph_engine(pdef, n, f, cregions, cpr, cmds, window, conflict,
+                      read_only_pct, reorder_hash, pregions, seed):
+    """Shared engine-side run for the full-protocol oracle comparisons
+    (Atlas/EPaxos and Tempo): build the config/workload/spec/env, run the
+    engine, extract the compared observables, and precompute the workload
+    stream the oracle consumes as plain arrays."""
     import jax.numpy as jnp
 
     from fantoch_tpu.core import workload as workload_mod
-    from fantoch_tpu.engine.lockstep import reorder_salt
-    from fantoch_tpu.protocols import atlas as atlas_proto
-    from fantoch_tpu.protocols import epaxos as epaxos_proto
-    from fantoch_tpu.utils.native import sim_atlas_oracle
 
     planet = Planet.new()
     config = Config(n=n, f=f, gc_interval_ms=100)
@@ -186,11 +181,6 @@ def run_both_atlas(variant, n, f, pregions, cregions, cpr, cmds, window,
         keys_per_command=1,
         commands_per_client=cmds,
         read_only_percentage=read_only_pct,
-    )
-    pdef = (
-        atlas_proto.make_protocol(n, 1)
-        if variant == 0
-        else epaxos_proto.make_protocol(n, 1)
     )
     C = len(cregions) * cpr
     spec = setup.build_spec(
@@ -221,8 +211,6 @@ def run_both_atlas(variant, n, f, pregions, cregions, cpr, cmds, window,
         "steps": int(st.step),
     }
 
-    # precompute the workload stream with the engine's own sampler: the
-    # oracle receives keys/read-only flags as plain arrays
     consts = workload_mod.WorkloadConsts.build(workload)
     key = jax.random.wrap_key_data(jnp.asarray(env.seed))
     cids = jnp.repeat(jnp.arange(C, dtype=jnp.int32), cmds)
@@ -234,10 +222,34 @@ def run_both_atlas(variant, n, f, pregions, cregions, cpr, cmds, window,
     )(cids, idxs)
     keys = np.asarray(keys).reshape(C, cmds, 1)
     ro = np.asarray(ro).reshape(C, cmds).astype(np.int32)
+    return engine, spec, env, keys, ro
 
+
+def run_both_atlas(variant, n, f, pregions, cregions, cpr, cmds, window,
+                   conflict, read_only_pct, reorder_hash, seed=0):
+    """Atlas/EPaxos engine vs the native dependency-graph oracle
+    (native/atlas_oracle.cpp): the hardest kernels — per-key dep collection,
+    quorum fast-path checks, synod slow path, the graph executor's
+    SCC-ready ordering and windowed GC compaction — cross-checked against an
+    independent map-based C++ implementation, optionally under the
+    deterministic hash-reorder mode."""
+    from fantoch_tpu.engine.lockstep import reorder_salt
+    from fantoch_tpu.protocols import atlas as atlas_proto
+    from fantoch_tpu.protocols import epaxos as epaxos_proto
+    from fantoch_tpu.utils.native import sim_atlas_oracle
+
+    pdef = (
+        atlas_proto.make_protocol(n, 1)
+        if variant == 0
+        else epaxos_proto.make_protocol(n, 1)
+    )
+    engine, spec, env, keys, ro = _run_graph_engine(
+        pdef, n, f, cregions, cpr, cmds, window, conflict, read_only_pct,
+        reorder_hash, pregions, seed,
+    )
     oracle = sim_atlas_oracle(
         n=n,
-        n_clients=C,
+        n_clients=len(cregions) * cpr,
         keys_per_command=1,
         max_seq=spec.max_seq,
         commands_per_client=cmds,
@@ -317,4 +329,87 @@ def test_engine_matches_native_oracle_fpaxos(n, f, leader, pregions, cregions,
     np.testing.assert_array_equal(engine["lat_sum"], oracle["lat_sum"])
     np.testing.assert_array_equal(engine["commit_count"], oracle["commit_count"])
     np.testing.assert_array_equal(engine["stable_count"], oracle["stable_count"])
+    assert abs(engine["steps"] - oracle["steps"]) <= 16
+
+
+def run_both_tempo(n, f, pregions, cregions, cpr, cmds, window, conflict,
+                   read_only_pct, reorder_hash, seed=0):
+    """Tempo engine vs the native votes-table oracle
+    (native/tempo_oracle.cpp): clock proposals + vote ranges, the
+    QuorumClocks fast-path threshold, synod slow path, eager detached votes
+    and the TableExecutor's (clock, dot) stability ordering — the last
+    executor without a second implementation (round-2 verdict gap),
+    cross-checked end to end, optionally under deterministic hash-reorder."""
+    from fantoch_tpu.engine.lockstep import reorder_salt
+    from fantoch_tpu.protocols import tempo as tempo_proto
+    from fantoch_tpu.utils.native import sim_tempo_oracle
+
+    pdef = tempo_proto.make_protocol(n, 1)
+    engine, spec, env, keys, ro = _run_graph_engine(
+        pdef, n, f, cregions, cpr, cmds, window, conflict, read_only_pct,
+        reorder_hash, pregions, seed,
+    )
+    oracle = sim_tempo_oracle(
+        n=n,
+        n_clients=len(cregions) * cpr,
+        keys_per_command=1,
+        max_seq=spec.max_seq,
+        commands_per_client=cmds,
+        fq_minority=n // 2,
+        stability_threshold=int(env.threshold),
+        wq_size=int(env.wq_size),
+        max_res=spec.max_res,
+        extra_ms=spec.extra_ms,
+        gc_interval_ms=100,
+        executed_ms=spec.executed_ms,
+        cleanup_ms=spec.cleanup_ms,
+        reorder_hash=reorder_hash,
+        salt=int(np.asarray(reorder_salt(env))),
+        key_space=spec.key_space,
+        max_steps=spec.max_steps,
+        dist_pp=env.dist_pp,
+        dist_pc=env.dist_pc,
+        dist_cp=env.dist_cp[:, 0],
+        client_proc=env.client_proc[:, 0],
+        fq_mask=env.fq_mask,
+        wq_mask=env.wq_mask,
+        keys=keys,
+        read_only=ro,
+    )
+    return engine, oracle
+
+
+TEMPO_CASES = [
+    # (n, f, pregions, cregions, cpr, cmds, window, conflict, ro%, reorder)
+    (3, 1, ["asia-east1", "us-central1", "us-west1"],
+     ["us-west1", "us-west2"], 1, 20, 8, 100, 0, False),
+    (3, 1, ["asia-east1", "us-central1", "us-west1"],
+     ["us-west1", "us-west2"], 2, 15, 6, 100, 20, True),
+    (5, 2, ["asia-east1", "us-central1", "us-west1", "europe-west2",
+            "europe-west3"], ["us-west1", "europe-west2"], 2, 10, 8, 100,
+     0, True),
+]
+
+
+@pytest.mark.parametrize(
+    "n,f,pregions,cregions,cpr,cmds,window,conflict,ro,reorder", TEMPO_CASES
+)
+def test_engine_matches_native_oracle_tempo(n, f, pregions, cregions, cpr,
+                                            cmds, window, conflict, ro,
+                                            reorder):
+    engine, oracle = run_both_tempo(
+        n, f, pregions, cregions, cpr, cmds, window, conflict, ro, reorder,
+    )
+    np.testing.assert_array_equal(engine["lat_cnt"], oracle["lat_cnt"])
+    np.testing.assert_array_equal(engine["lat_sum"], oracle["lat_sum"])
+    np.testing.assert_array_equal(engine["commit_count"], oracle["commit_count"])
+    np.testing.assert_array_equal(engine["stable_count"], oracle["stable_count"])
+    np.testing.assert_array_equal(engine["fast_count"], oracle["fast_count"])
+    np.testing.assert_array_equal(engine["slow_count"], oracle["slow_count"])
+    # per-(process, key) rolling execution-order hashes: equality means the
+    # votes-table stability kernel ordered every command exactly like the
+    # oracle's frontier/parked-range implementation
+    np.testing.assert_array_equal(engine["order_hash"], oracle["order_hash"])
+    np.testing.assert_array_equal(engine["order_cnt"], oracle["order_cnt"])
+    np.testing.assert_array_equal(engine["c_vals"], oracle["c_vals"])
     assert abs(engine["steps"] - oracle["steps"]) <= 16
