@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import glob
+import os
 import resource
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -214,6 +216,8 @@ def run_grid(
     profile_dir: Optional[str] = None,
     metrics_log: Optional[str] = None,
     pool_slots: Optional[int] = None,
+    resume: bool = False,
+    stats: Optional[Dict[str, int]] = None,
 ) -> List[str]:
     """Run every grid point and persist one results dir per shape bucket.
 
@@ -242,7 +246,36 @@ def run_grid(
         buckets.setdefault(_bucket_key(pt), []).append(pt)
 
     out_dirs: List[str] = []
+    if stats is not None:
+        stats.update({"buckets": len(buckets), "skipped": 0})
     for bi, (bkey, bpoints) in enumerate(sorted(buckets.items())):
+        if resume:
+            # segment-safe restarts for long tunneled sweeps: every bucket
+            # persists its own results dir (data.npz published atomically,
+            # plot/db.py save_sweep), so a crashed run resumes by skipping
+            # buckets whose data landed AND whose recorded search list
+            # matches this bucket's points (a changed grid re-runs)
+            want = [pt.search() for pt in bpoints]
+            done_dirs = []
+            for d in glob.glob(os.path.join(results_root, f"*_{name}_b{bi}")):
+                if not os.path.exists(os.path.join(d, "data.npz")):
+                    continue
+                try:
+                    import json as _json
+
+                    with open(os.path.join(d, "meta.json")) as f:
+                        if _json.load(f).get("searches") == want:
+                            done_dirs.append(d)
+                except (OSError, ValueError):
+                    continue
+            if done_dirs:
+                out_dirs.append(done_dirs[0])
+                if stats is not None:
+                    stats["skipped"] += 1
+                if verbose:
+                    print(f"bucket {bi}: resume skip -> {done_dirs[0]}",
+                          flush=True)
+                continue
         pt0 = bpoints[0]
         n = pt0.n
         pregions = list(process_regions or [])

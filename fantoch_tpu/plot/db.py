@@ -97,7 +97,11 @@ def save_sweep(
     }
     for k, v in (metrics or {}).items():
         arrays[f"metric_{k}"] = np.asarray(v)
-    np.savez_compressed(os.path.join(out, "data.npz"), **arrays)
+    # atomic publish: a crash mid-write must not leave a truncated data.npz
+    # that a resumed sweep (exp/harness.py run_grid resume=True) would trust
+    tmp = os.path.join(out, "data.npz.tmp")
+    np.savez_compressed(tmp, **arrays)
+    os.replace(tmp, os.path.join(out, "data.npz"))
     return out
 
 

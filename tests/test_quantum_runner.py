@@ -95,7 +95,7 @@ def test_quantum_runner_matches_event_engine():
     )
 
 
-def _run_both_engines(pdef, config, wl=None):
+def _run_both_engines(pdef, config, wl=None, process_regions=None):
     """Run one 8-process config (single- or multi-shard) under the event
     engine and the quantum runner; returns (engine_state, runner_state) as
     numpy pytrees after asserting equal latency histograms."""
@@ -106,7 +106,9 @@ def _run_both_engines(pdef, config, wl=None):
         config, wl, pdef, n_clients=2, n_client_groups=2,
         extra_ms=1000, max_steps=5_000_000,
     )
-    placement = setup.Placement(PROCESS_REGIONS[: config.n], CLIENT_REGIONS, 1)
+    placement = setup.Placement(
+        process_regions or PROCESS_REGIONS[: config.n], CLIENT_REGIONS, 1
+    )
     env = setup.build_env(spec, config, planet, placement, wl, pdef)
 
     st = jax.jit(lockstep.make_run(spec, pdef, wl))(env)
@@ -178,6 +180,34 @@ def test_quantum_runner_matches_event_engine_caesar():
     st, rst = _run_both_engines(
         caesar_proto.make_protocol(8, 1, max_seq=16),
         Config(n=8, f=1, gc_interval_ms=100),
+    )
+    for counter in ("commit_count", "stable_count"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(rst.proto, counter)),
+            np.asarray(getattr(st.proto, counter)),
+        )
+    np.testing.assert_array_equal(
+        np.asarray(rst.exec.order_hash), np.asarray(st.exec.order_hash)
+    )
+
+
+def test_quantum_runner_matches_event_engine_caesar_colocated():
+    """Caesar with COLOCATED (0 ms apart) processes — the configuration
+    class that breaks same-instant tie-order bugs loose (every quorum reply
+    and unblock cascade lands in the same instant, so the wait condition,
+    reject/retry and unblock logic run entirely on tie-break order). Two
+    clients sit in the same region as half the processes, so submits and
+    replies are 0 ms too."""
+    from fantoch_tpu.protocols import caesar as caesar_proto
+
+    st, rst = _run_both_engines(
+        caesar_proto.make_protocol(8, 1, max_seq=16),
+        Config(n=8, f=1, gc_interval_ms=100),
+        # four processes in us-west1 (with both client regions' closest
+        # processes among them), four in europe-west2
+        process_regions=["us-west1", "us-west1", "us-west1", "us-west1",
+                         "europe-west2", "europe-west2", "europe-west2",
+                         "europe-west2"],
     )
     for counter in ("commit_count", "stable_count"):
         np.testing.assert_array_equal(
