@@ -199,6 +199,27 @@ def _bucket_key(pt: Point) -> Tuple:
     )
 
 
+def _engine_fingerprint(pt0, C: int) -> Dict[str, int]:
+    """Engine parameters derived from CODE rather than the grid — recorded
+    in each bucket's meta and compared on resume, so a policy change (e.g.
+    the ring-window floor) forces a re-run instead of silently mixing
+    results from two engine configurations.
+
+    Ring window: ~3x the worst per-coordinator in-flight population (every
+    client on one coordinator + GC-report lag). Per-trip cost scales with
+    the per-dot window state — the graph executor's closure is O(DOTS^2)
+    per trip with DOTS = n * max_seq, so an oversized floor made n=9
+    sweeps crash the tunneled worker's watchdog; window deferral (submits
+    wait, never drop) covers the tail instead. FPaxos/Caesar run
+    unwindowed (static dot space)."""
+    total_cmds = C * pt0.commands_per_client
+    if pt0.protocol in ("basic", "tempo", "atlas", "epaxos", "janus"):
+        max_seq = min(total_cmds, max(24, 3 * C))
+    else:
+        max_seq = total_cmds
+    return {"max_seq": int(max_seq)}
+
+
 def run_grid(
     points: Sequence[Point],
     *,
@@ -264,8 +285,18 @@ def run_grid(
                     import json as _json
 
                     with open(os.path.join(d, "meta.json")) as f:
-                        if _json.load(f).get("searches") == want:
-                            done_dirs.append(d)
+                        meta = _json.load(f)
+                    # the engine-parameter fingerprint guards against
+                    # resuming across code changes that alter the sim
+                    # (e.g. the ring-window policy) without changing the
+                    # grid; absent in pre-fingerprint dirs -> re-run
+                    C_b = (
+                        len(client_regions) * bpoints[0].clients_per_region
+                    )
+                    if meta.get("searches") == want and meta.get(
+                        "engine_params"
+                    ) == _engine_fingerprint(bpoints[0], C_b):
+                        done_dirs.append(d)
                 except (OSError, ValueError):
                     continue
             if done_dirs:
@@ -290,10 +321,7 @@ def run_grid(
         # per-dot state (and the graph executor's closure) stays sized by
         # the in-flight window; submits defer (never drop) under pressure.
         # FPaxos/Caesar run unwindowed (static dot space).
-        if pt0.protocol in ("basic", "tempo", "atlas", "epaxos", "janus"):
-            max_seq = min(total_cmds, max(64, 4 * C))
-        else:
-            max_seq = total_cmds
+        max_seq = _engine_fingerprint(pt0, C)["max_seq"]
         pdef = make_protocol_def(
             pt0.protocol,
             n,
@@ -426,7 +454,11 @@ def run_grid(
                 steps=np.asarray(st.step),
                 client_regions=client_regions,
                 metrics=metrics,
-                extra_meta={"process_regions": list(pregions), "dstat": dstat},
+                extra_meta={
+                    "process_regions": list(pregions),
+                    "dstat": dstat,
+                    "engine_params": _engine_fingerprint(pt0, C),
+                },
             )
         )
         if verbose:

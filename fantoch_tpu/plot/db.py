@@ -98,8 +98,10 @@ def save_sweep(
     for k, v in (metrics or {}).items():
         arrays[f"metric_{k}"] = np.asarray(v)
     # atomic publish: a crash mid-write must not leave a truncated data.npz
-    # that a resumed sweep (exp/harness.py run_grid resume=True) would trust
-    tmp = os.path.join(out, "data.npz.tmp")
+    # that a resumed sweep (exp/harness.py run_grid resume=True) would
+    # trust. The temp name must END in .npz — np.savez appends the suffix
+    # otherwise and the rename source would not exist.
+    tmp = os.path.join(out, "data.tmp.npz")
     np.savez_compressed(tmp, **arrays)
     os.replace(tmp, os.path.join(out, "data.npz"))
     return out

@@ -24,3 +24,31 @@ _cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cach
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+
+# ---------------------------------------------------------------------------
+# heavy tier: redundant-coverage equality sweeps, skipped by default on this
+# 1-core host and run at least once per round with FANTOCH_HEAVY=1 (see
+# .claude/skills/verify/SKILL.md). Every subsystem keeps at least one
+# default-tier test asserting its invariants; the heavy tier holds the
+# near-duplicate configs (same assertions, different shapes/seeds).
+# ---------------------------------------------------------------------------
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "heavy: redundant-coverage sweep, skipped unless FANTOCH_HEAVY=1",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    if os.environ.get("FANTOCH_HEAVY", "") not in ("", "0"):
+        return
+    skip = pytest.mark.skip(
+        reason="heavy tier: set FANTOCH_HEAVY=1 (run at least once per round)"
+    )
+    for item in items:
+        if "heavy" in item.keywords:
+            item.add_marker(skip)

@@ -13,6 +13,7 @@ atlas/epaxos sections):
 """
 import jax
 import numpy as np
+import pytest
 
 from fantoch_tpu.core.config import Config
 from fantoch_tpu.core.planet import Planet
@@ -85,12 +86,14 @@ def test_atlas_n3_f1():
     assert metrics["slow"].sum() == 0, metrics["slow"]
 
 
+@pytest.mark.heavy
 def test_atlas_n5_f1():
     st, metrics, spec = run("atlas", 5, 1)
     check(st, metrics, spec)
     assert metrics["slow"].sum() == 0, metrics["slow"]
 
 
+@pytest.mark.heavy
 def test_atlas_n5_f2_takes_slow_paths():
     st, metrics, spec = run("atlas", 5, 2, conflict_rate=100, reorder=True, seed=3)
     check(st, metrics, spec)

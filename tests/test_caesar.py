@@ -9,6 +9,7 @@ execution-order agreement.
 """
 import jax
 import numpy as np
+import pytest
 
 from fantoch_tpu.core.config import Config
 from fantoch_tpu.core.planet import Planet
@@ -103,6 +104,7 @@ def test_caesar_wait_n5_f2():
     check(st, metrics, spec)
 
 
+@pytest.mark.heavy
 def test_caesar_no_wait_n5_f2():
     st, metrics, spec = run(5, 2, wait_condition=False)
     check(st, metrics, spec)

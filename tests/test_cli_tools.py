@@ -2,6 +2,8 @@
 graph_executor_replay / shard_distribution binaries)."""
 import json
 
+import pytest
+
 from fantoch_tpu.__main__ import main
 from fantoch_tpu.exp.harness import replay_graph_stream
 
@@ -89,6 +91,7 @@ def test_cli_sequencer_bench(capsys):
     assert out["proposals_per_sec"] > 0
 
 
+@pytest.mark.heavy
 def test_cli_protocol_flags(capsys, tmp_path):
     """The sim CLI exposes the reference's protocol flags
     (bin/common/protocol.rs): drive tempo with tiny quorums + skip_fast_ack

@@ -8,6 +8,8 @@ plot family.
 import json
 import os
 
+import pytest
+
 from fantoch_tpu.exp.harness import Point, run_grid
 from fantoch_tpu.plot.db import ResultsDB
 from fantoch_tpu.plot import plots
@@ -87,6 +89,7 @@ def test_grid_db_plots(tmp_path):
     assert "wall_s" in table and len(table.splitlines()) == 3, table
 
 
+@pytest.mark.heavy
 def test_batching_grid_and_plot(tmp_path):
     """Open-loop batching through the harness: larger batches use fewer
     dots; the batching_plot renders from the results DB."""

@@ -13,6 +13,7 @@ Mirrors the reference's sim-based protocol tests
 """
 import jax
 import numpy as np
+import pytest
 
 from fantoch_tpu.core.config import Config
 from fantoch_tpu.core.planet import Planet
@@ -95,6 +96,7 @@ def test_fpaxos_n3_f1():
     check(3, 1, leader_id=1)
 
 
+@pytest.mark.heavy
 def test_fpaxos_n5_f1():
     check(5, 1, leader_id=1)
 

@@ -63,7 +63,8 @@ def run_once(proto_mod, *, exact, open_loop=False, n=3, f=1, cmds=10,
 CASES = [
     ("basic", False),
     ("basic", True),  # open loop: pending self-ticks stress the horizon
-    ("tempo", False),
+    # tempo's fast-path schedule is also pinned by test_row_schedules_agree
+    pytest.param("tempo", False, marks=pytest.mark.heavy),
     ("atlas", False),
 ]
 

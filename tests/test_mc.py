@@ -18,11 +18,13 @@ def test_synod_agreement_holds():
     assert res["states"] > 1000, res
 
 
+@pytest.mark.heavy
 def test_checker_catches_broken_accept_guard():
     res = check_agreement(SynodModel(break_accept_guard=True))
     assert res["violation"], res
 
 
+@pytest.mark.heavy
 def test_checker_catches_broken_adoption():
     res = check_agreement(SynodModel(break_adoption=True))
     assert res["violation"], res

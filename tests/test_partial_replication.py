@@ -121,6 +121,7 @@ def run_tempo_shards(shards, kpc, conflict, cmds=15):
     return run_proto_shards(tempo_proto, shards, kpc, conflict, cmds=cmds)
 
 
+@pytest.mark.heavy
 def test_tempo_two_shards_single_key_commands():
     st, env, spec = run_tempo_shards(shards=2, kpc=1, conflict=50)
     assert int(st.c_done.sum()) == 2
@@ -200,6 +201,7 @@ def check_shard_order_agreement(st, spec):
             assert (oc[m][~owned] == 0).all()
 
 
+@pytest.mark.heavy
 def test_atlas_two_shards_single_key_commands():
     from fantoch_tpu.protocols import atlas as atlas_proto
 
@@ -226,6 +228,7 @@ def test_atlas_two_shards_spanning_commands():
     assert int(np.asarray(st.exec.out_requests).sum()) > 0
 
 
+@pytest.mark.heavy
 def test_epaxos_two_shards_spanning_commands():
     from fantoch_tpu.protocols import epaxos as epaxos_proto
 

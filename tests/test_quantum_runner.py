@@ -8,6 +8,7 @@ client latency histograms, commit counts, and GC-stable counters.
 """
 import jax
 import numpy as np
+import pytest
 
 from fantoch_tpu.core.config import Config
 from fantoch_tpu.core.planet import Planet
@@ -133,6 +134,7 @@ def _run_both_engines(pdef, config, wl=None, process_regions=None):
     return st, rst
 
 
+@pytest.mark.heavy
 def test_quantum_runner_matches_event_engine_tempo():
     """The runner is protocol-generic: the flagship protocol (Tempo, with
     its table executor, detached votes, and synod slow path) produces the
@@ -171,6 +173,7 @@ def test_quantum_runner_matches_event_engine_atlas():
     )
 
 
+@pytest.mark.heavy
 def test_quantum_runner_matches_event_engine_caesar():
     """The wait-condition protocol under the runner: MUnblock self-send
     cascades, retry aggregation, and the predecessors executor match the
@@ -244,6 +247,7 @@ def test_quantum_runner_matches_event_engine_basic_sharded():
     )
 
 
+@pytest.mark.heavy
 def test_quantum_runner_matches_event_engine_tempo_sharded():
     from fantoch_tpu.protocols import tempo as tempo_proto
 
@@ -258,6 +262,7 @@ def test_quantum_runner_matches_event_engine_tempo_sharded():
         )
 
 
+@pytest.mark.heavy
 def test_quantum_runner_matches_event_engine_atlas_sharded():
     from fantoch_tpu.protocols import atlas as atlas_proto
 
@@ -325,6 +330,7 @@ def test_quantum_runner_matches_event_engine_open_loop():
     )
 
 
+@pytest.mark.heavy
 def test_quantum_runner_matches_event_engine_open_loop_sharded():
     """Open loop x partial replication: concurrent outstanding rifls each
     aggregate KPC=2 partials across two shards at the owner device

@@ -109,6 +109,7 @@ def test_tempo_n3_f1():
     assert metrics["slow"].sum() == 0, metrics["slow"]
 
 
+@pytest.mark.heavy
 def test_tempo_n5_f1():
     st, metrics, spec = run(5, 1)
     check(st, metrics, spec)
