@@ -77,6 +77,14 @@ from .types import (
 
 _BIG = jnp.int32(2**30)
 
+# Observable-contract version of the engine loops. Bumped whenever a change
+# can alter ANY observable of a finished run (tie-key discipline, drain
+# semantics, delivery eligibility, metric counting) — sweep-resume
+# fingerprints record it (exp/harness.py) so stale buckets from an older
+# contract re-run instead of silently mixing. Pure scheduling changes that
+# the A/B equality suite proves unobservable do NOT bump it.
+ENGINE_CONTRACT = 4
+
 
 @dataclasses.dataclass(frozen=True)
 class SimSpec:
@@ -361,11 +369,42 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
     NT = NPER - 1  # fast-path timer slots (the trailing cleanup tick is
     # subsumed by the per-trip trailing drain; see _fast_round docstring)
     _HUGE = jnp.int32(2**31 - 1)
-    if FAST:
-        assert DTOT < 128, (
-            f"{DTOT} sources exceed the 7-bit gsrc of the fast-path tie key"
-            " (gsrc * 2^24 + seq in one int32)"
+    if FAST and DTOT >= 128:
+        # the fast-path tie key packs gsrc * 2^24 + seq in one int32 (7-bit
+        # gsrc); larger configs degrade to the exact global-instant loop,
+        # which has no such bound, instead of refusing to run
+        import warnings
+
+        warnings.warn(
+            f"{DTOT} sources exceed the 7-bit gsrc of the fast-path tie key;"
+            " falling back to the exact global-instant loop"
         )
+        FAST = False
+
+    # silent-prefix run folding (lookahead loop only): each singleton
+    # zero-distance component may consume up to FOLD messages per trip —
+    # the first by the normal instant discipline, the rest only while every
+    # earlier one produced NO emissions (no outbox rows, no drained
+    # results). Quorum-ack prefixes (MCollectAck/MProposeAck counting below
+    # threshold) are exactly this shape, so ack storms fold into one trip.
+    # Abort-on-emission keeps the observable schedule bit-identical to the
+    # single-pop contract: silent events have no observables other than
+    # their state updates, consumed messages follow the exact (time,
+    # (gsrc, seq)) order, and the emitting step's messages carry its own
+    # instant and the unchanged per-source emission counters. The A/B +
+    # native-oracle equality suites pin the "no observable change" claim.
+    #
+    # Default OFF (FOLD=1): measured on a v5e chip at the bench shapes,
+    # folding LOSES ~2x — under vmap the per-trip cost is dominated by the
+    # handler/drain tensor updates, and lax.cond lowers to computing both
+    # sides, so every trip pays all KF extra handler invocations whether or
+    # not a row folds, while the realized fold rate (gated by timers,
+    # pending submits and multi-member components) is small. On the CPU
+    # row-loop schedule the cond skips for real, so FANTOCH_FOLD>1 can pay
+    # there; the batch axis, not per-config event grouping, is the TPU
+    # throughput lever (bench.py).
+    FOLD = int(os.environ.get("FANTOCH_FOLD", "1")) if FAST else 1
+    KF = max(0, FOLD - 1)  # fold steps per trip beyond the first message
 
     # ------------------------------------------------------------------
     # pool insertion (bulk, dense)
@@ -1350,10 +1389,14 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         return comp, ext, lk2c
 
     def _fast_row_core(ctx, proto1, exec1, has_p, kind_p, src_p, pay_p,
-                       flat_p, subok_p, tmr_p, k_p, act_p, now_p, obr, obw):
+                       flat_p, subok_p, tmr_p, k_p, act_p, now_p, obr, obw,
+                       fk_valid, fk_kind, fk_src, fk_pay, fk_t):
         """One process row of a lookahead trip: handle a message OR fire the
-        component's due periodic slot, then run one shared executor drain.
-        Returns (pstate, estate, Outbox [obr, obw], ResOut, drain_pending)."""
+        component's due periodic slot, then run one shared executor drain —
+        then consume up to KF more pre-selected messages (`fk_*`, in exact
+        (time, tie) order) while each earlier step stayed silent.
+        Returns (pstate, estate, Outbox [obr, obw], ResOut, drain_pending,
+        consumed [KF] bool, when_emit)."""
         z = jnp.int32(0)
         is_sub = has_p & (kind_p == KIND_SUBMIT)
         is_proto = has_p & (kind_p >= KIND_PROTO_BASE)
@@ -1435,14 +1478,77 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         # a full drain may have left ready results behind the MR bound:
         # retry at the same instant next trip instead of waiting for a tick
         dp_new = act_p & res.valid.all()
-        return pst, est, ob, res, dp_new
+
+        if KF == 0:
+            return (pst, est, ob, res, dp_new,
+                    jnp.zeros((0,), jnp.bool_), now_p)
+
+        # --- silent-prefix fold steps: keep consuming while nothing was
+        # emitted (no outbox rows, no drained results) by the prior step ---
+        silent1 = (
+            has_p & ~tmr_p & ~ob.valid.any() & ~res.valid.any() & act_p
+        )
+
+        def fold_step(carry, xs):
+            pstc, estc, ob_a, res_a, when_a, dp_a, cont = carry
+            k_j, s_j, pay_j, t_j, v_j = xs
+            go = cont & v_j
+
+            def do(args):
+                pstx, estx = args
+                pk_j = jnp.clip(
+                    k_j - KIND_PROTO_BASE, 0, pdef.n_msg_kinds - 1
+                )
+                pst2, ob2, ex2 = pdef.handle(
+                    ctx, pstx, z, s_j, pk_j, pay_j, t_j
+                )
+                est2 = estx
+                for i in range(pdef.max_exec):
+                    newe = exdef.handle(ctx, est2, z, ex2.info[i], t_j)
+                    est2 = _tree_select(ex2.valid[i], newe, est2)
+                est3, res2 = exdef.drain(ctx, est2, z)
+                return pst2, est3, _pad_ob(ob2, obr, obw), res2
+
+            def skip(args):
+                pstx, estx = args
+                return (
+                    pstx,
+                    estx,
+                    Outbox(
+                        valid=jnp.zeros((obr,), jnp.bool_),
+                        tgt_mask=jnp.zeros((obr,), jnp.int32),
+                        kind=jnp.zeros((obr,), jnp.int32),
+                        payload=jnp.zeros((obr, obw), jnp.int32),
+                    ),
+                    _empty_res(),
+                )
+
+            pst2, est2, ob2, res2 = jax.lax.cond(go, do, skip, (pstc, estc))
+            emitted = ob2.valid.any() | res2.valid.any()
+            pstc = _tree_select(go, pst2, pstc)
+            estc = _tree_select(go, est2, estc)
+            # at most one step of the whole run emits (cont dies on the
+            # first emission), so overwrite-on-consume is select, not merge
+            ob_a = _tree_select(go, ob2, ob_a)
+            res_a = _tree_select(go, res2, res_a)
+            when_a = jnp.where(go, t_j, when_a)
+            dp_a = jnp.where(go, res2.valid.all(), dp_a)
+            return (pstc, estc, ob_a, res_a, when_a, dp_a, go & ~emitted), go
+
+        carry0 = (pst, est, ob, res, now_p, dp_new, silent1)
+        (pst, est, ob, res, when_e, dp_new, _), consumed = jax.lax.scan(
+            fold_step, carry0, (fk_kind, fk_src, fk_pay, fk_t, fk_valid)
+        )
+        return pst, est, ob, res, dp_new, consumed, when_e
 
     def _proc_rows_fast(st: SimState, env: Env, cmds: CmdView, has, kind,
-                        src, payload, gdot, subok, tmr, kslot, dp, now_p):
+                        src, payload, gdot, subok, tmr, kslot, dp, now_p,
+                        fk_valid, fk_kind, fk_src, fk_pay, fk_t):
         """The merged per-process row pass of a lookahead trip (messages,
         periodic slots and drains in one pass) — vmapped on TPU, a
         statically-unrolled idle-skipping loop on CPU, exactly like
-        `_proc_rows`."""
+        `_proc_rows`. `fk_*` [n, KF(, W)] are the pre-selected fold
+        messages."""
         act = has | tmr | dp
 
         # common padded outbox shape across the message path and slot fns
@@ -1460,7 +1566,7 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         obw = max([pdef.msg_width] + [s.payload.shape[1] for s in tshapes])
 
         if ROW_LOOP:
-            prots, execs, obs, ress, dps = [], [], [], [], []
+            prots, execs, obs, ress, dps, cons, whens = [], [], [], [], [], [], []
             for pid in range(n):
                 proto1 = jax.tree_util.tree_map(lambda a: a[pid:pid + 1], st.proto)
                 exec1 = jax.tree_util.tree_map(lambda a: a[pid:pid + 1], st.exec)
@@ -1472,9 +1578,11 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
                         ctx, proto1, exec1, has[pid], kind[pid], src[pid],
                         payload[pid], gdot[pid], subok[pid], tmr[pid],
                         kslot[pid], act[pid], now_p[pid], obr, obw,
+                        fk_valid[pid], fk_kind[pid], fk_src[pid],
+                        fk_pay[pid], fk_t[pid],
                     )
 
-                def idle(_, proto1=proto1, exec1=exec1):
+                def idle(_, proto1=proto1, exec1=exec1, pid=pid):
                     return (
                         proto1, exec1,
                         Outbox(
@@ -1485,14 +1593,20 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
                         ),
                         _empty_res(),
                         jnp.bool_(False),
+                        jnp.zeros((KF,), jnp.bool_),
+                        now_p[pid],
                     )
 
-                pst, est, ob, res, dpn = jax.lax.cond(act[pid], active, idle, None)
+                pst, est, ob, res, dpn, con, whn = jax.lax.cond(
+                    act[pid], active, idle, None
+                )
                 prots.append(pst)
                 execs.append(est)
                 obs.append(ob)
                 ress.append(res)
                 dps.append(dpn)
+                cons.append(con)
+                whens.append(whn)
             cat = lambda *xs: jnp.concatenate(xs)
             return (
                 jax.tree_util.tree_map(cat, *prots),
@@ -1500,23 +1614,30 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
                 jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *obs),
                 jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *ress),
                 jnp.stack(dps),
+                jnp.stack(cons),
+                jnp.stack(whens),
             )
 
         def row(pid, env_row, proto_row, exec_row, has_p, kind_p, src_p,
-                pay_p, flat_p, subok_p, tmr_p, k_p, act_p, now_r):
+                pay_p, flat_p, subok_p, tmr_p, k_p, act_p, now_r,
+                fkv, fkk, fks, fkp, fkt):
             proto1 = _lift(proto_row)
             exec1 = _lift(exec_row)
             ctx = Ctx(spec=spec, env=_lift_env(env_row), cmds=cmds, pid=pid)
-            pst, est, ob, res, dpn = _fast_row_core(
+            pst, est, ob, res, dpn, con, whn = _fast_row_core(
                 ctx, proto1, exec1, has_p, kind_p, src_p, pay_p, flat_p,
                 subok_p, tmr_p, k_p, act_p, now_r, obr, obw,
+                fkv, fkk, fks, fkp, fkt,
             )
-            return _unlift(pst), _unlift(est), ob, res, dpn
+            return _unlift(pst), _unlift(est), ob, res, dpn, con, whn
 
         return jax.vmap(
-            row, in_axes=(0, ENV_AXES, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0)
+            row,
+            in_axes=(0, ENV_AXES, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+                     0, 0, 0, 0, 0),
         )(proc_ids, env, st.proto, st.exec, has, kind, src, payload, gdot,
-          subok, tmr, kslot, act, now_p)
+          subok, tmr, kslot, act, now_p, fk_valid, fk_kind, fk_src, fk_pay,
+          fk_t)
 
     def _fast_round(env: Env, aux, st: SimState) -> SimState:
         """One lookahead trip: every safely-advanceable component runs one
@@ -1563,9 +1684,22 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         hsum = jnp.minimum(tau, half)[:, None] + jnp.minimum(lk2c, half)
         h = jnp.min(jnp.where(ext, hsum, INF), axis=0)  # [D]
         # post-completion drain gate: never act past final_time (the exact
-        # loop stops there; extra_ms >> network diameter keeps same-trip
-        # overshoot impossible before final_time is set)
-        safe = (T < h) & (T < INF) & (T <= st.final_time)
+        # loop stops there). Before final_time is even SET, a component could
+        # in principle overshoot the eventual final_time whenever
+        # extra_ms < network diameter — so additionally bound every component
+        # to at most extra_ms ahead of the global minimum pending instant:
+        # final_time >= min(tau) + extra_ms at the instant it is set, hence
+        # the bound makes pre-set overshoot impossible for ANY extra_ms. The
+        # global-minimum component always passes, so liveness is unaffected,
+        # and the gate is pure scheduling (observables pinned by the A/B
+        # equality suite, tests/test_lookahead.py).
+        tmin = jnp.min(tau)
+        skew_bound = jnp.where(
+            tmin >= INF, INF, tmin + jnp.int32(spec.extra_ms)
+        )
+        safe = (
+            (T < h) & (T < INF) & (T <= st.final_time) & (T <= skew_bound)
+        )
 
         # --- phase: messages before timers, per component ---
         m_at = (evt_msg == T) & (evt_msg < INF)  # [D]
@@ -1623,13 +1757,124 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         now_p = T[:n]
         now_c = T[n:]
 
+        # --- silent-prefix fold lists: up to KF more messages per singleton
+        # process row, in exact (time, tie) order, below every bound the
+        # step-1 instant itself honors (horizon, timers, final_time, skew).
+        # Multi-member components stay single-pop (a member's emission can
+        # reach a peer at 0 ms mid-run), and rows with a window-blocked
+        # submit in reach stay single-pop so the submit's delivery-at-
+        # unblocking instant (max(arrival, lc)) cannot skew past the
+        # unblocking trip. ---
+        fk_picks = []
+        if KF > 0:
+            sing = (jnp.sum(comp.astype(jnp.int32), axis=0) == 1)[:n]  # [n]
+            if pdef.window_floor is not None:
+                blocked = (
+                    st.m_valid & (st.m_kind == KIND_SUBMIT) & ~can_of_dst
+                )  # [S]
+                has_blocked = jnp.any(
+                    blocked[:, None]
+                    & (st.m_dst[:, None] == proc_ids[None, :])
+                    & (st.m_time[:, None] < h[None, :n]),
+                    axis=0,
+                )  # [n]
+            else:
+                has_blocked = jnp.zeros((n,), jnp.bool_)
+            fold_ok = sing & act_real[:n] & ~has_blocked
+            if NT > 0:
+                tmr_bound = jnp.min(st.per_next[:, :NT], axis=1)  # [n]
+            else:
+                tmr_bound = jnp.full((n,), INF, jnp.int32)
+            tbound = jnp.minimum(
+                tmr_bound,
+                jnp.minimum(st.final_time, skew_bound),
+            )  # [n]
+            # submits are never consumed by fold steps (their registration
+            # is a pre-pass), so they must BOUND the fold instead: folding
+            # past a pending submit's (time, tie) would advance lc beyond
+            # its arrival and delay its max(arrival, lc) delivery
+            submask = (
+                dm[:, :n]
+                & ~popm[:, :n]
+                & (st.m_kind == KIND_SUBMIT)[:, None]
+            )  # [S, n]
+            sub_t = jnp.min(
+                jnp.where(submask, st.m_time[:, None], INF), axis=0
+            )  # [n]
+            sub_seq = jnp.min(
+                jnp.where(
+                    submask & (st.m_time[:, None] == sub_t[None, :]),
+                    st.m_seq[:, None],
+                    _HUGE,
+                ),
+                axis=0,
+            )
+            below_sub = (st.m_time[:, None] < sub_t[None, :]) | (
+                (st.m_time[:, None] == sub_t[None, :])
+                & (st.m_seq[:, None] < sub_seq[None, :])
+            )
+            rem = (
+                dm[:, :n]
+                & ~popm[:, :n]
+                & (st.m_kind != KIND_SUBMIT)[:, None]
+                & (st.m_time[:, None] < h[None, :n])
+                & (st.m_time[:, None] <= tbound[None, :])
+                & below_sub
+                & fold_ok[None, :]
+            )  # [S, n]
+            fkv, fkk, fks, fkt, fkp = [], [], [], [], []
+            for _ in range(KF):
+                tmin_j = jnp.min(
+                    jnp.where(rem, st.m_time[:, None], INF), axis=0
+                )  # [n]
+                smin_j = jnp.min(
+                    jnp.where(
+                        rem & (st.m_time[:, None] == tmin_j[None, :]),
+                        st.m_seq[:, None],
+                        _HUGE,
+                    ),
+                    axis=0,
+                )
+                pick = (
+                    rem
+                    & (st.m_time[:, None] == tmin_j[None, :])
+                    & (st.m_seq[:, None] == smin_j[None, :])
+                )
+                pick = pick & (jnp.cumsum(pick.astype(jnp.int32), axis=0) == 1)
+                fkv.append(tmin_j < INF)
+                fkt.append(jnp.where(tmin_j < INF, tmin_j, 0))
+                fkk.append(rd_cols(pick, st.m_kind))
+                fks.append(rd_cols(pick, st.m_src))
+                fkp.append(
+                    jnp.sum(
+                        jnp.where(
+                            pick[:, :, None], st.m_payload[:, None, :], 0
+                        ),
+                        axis=0,
+                    )
+                )
+                fk_picks.append(pick)
+                rem = rem & ~pick
+            fk_valid = jnp.stack(fkv, axis=1)  # [n, KF]
+            fk_kind = jnp.stack(fkk, axis=1)
+            fk_src = jnp.stack(fks, axis=1)
+            fk_t = jnp.stack(fkt, axis=1)
+            fk_pay = jnp.stack(fkp, axis=1)  # [n, KF, W]
+        else:
+            fk_valid = jnp.zeros((n, 0), jnp.bool_)
+            fk_kind = jnp.zeros((n, 0), jnp.int32)
+            fk_src = jnp.zeros((n, 0), jnp.int32)
+            fk_t = jnp.zeros((n, 0), jnp.int32)
+            fk_pay = jnp.zeros((n, 0, W), jnp.int32)
+
         st, gdot, ok = _register_submits(st, has_p, kind_p, payload_p)
 
         # --- merged row pass + effects ---
         cmds = CmdView(st.cmd_client, st.cmd_rifl, st.cmd_keys, st.cmd_ro)
-        proto, exc, ob, res, dp_new = _proc_rows_fast(
+        proto, exc, ob, res, dp_new, consumed, when_e = _proc_rows_fast(
             st, env, cmds, has_p, kind_p, src_p, payload_p, gdot, ok,
             act_tmr, kstar, act_dp, now_p,
+            fk_valid, fk_kind, fk_src, fk_pay, fk_t,
         )
         acted_p = has_p | act_tmr | act_dp
         st = st._replace(
@@ -1640,6 +1885,16 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             # source's tau)
             drain_pend=jnp.where(acted_p, dp_new, st.drain_pend),
         )
+        if KF > 0:
+            # remove the messages the fold steps actually consumed
+            pickstack = jnp.stack(fk_picks, axis=2)  # [S, n, KF]
+            fold_clear = jnp.any(
+                pickstack & consumed[None, :, :], axis=(1, 2)
+            )  # [S]
+            st = st._replace(
+                m_valid=st.m_valid & ~fold_clear,
+                step=st.step + consumed.sum(),
+            )
         if NT > 0:
             koh = (
                 jnp.arange(NPER, dtype=jnp.int32)[None, :] == kstar[:, None]
@@ -1648,16 +1903,18 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
                 per_next=st.per_next
                 + jnp.where(koh & act_tmr[:, None], interval_arr[None, :], 0)
             )
-        st, replies = _route_results(st, env, res, now_p)
+        # emissions carry the emitting step's instant (`when_e` == now_p
+        # without folding; the last consumed step's instant with it)
+        st, replies = _route_results(st, env, res, when_e)
         st, subs, ticks = _client_rows(st, env, has_c, kind_c, payload_c, now_c)
         cand = _cat_cands(
-            [_expand_outbox(env, ob, now_p), replies, subs, ticks]
+            [_expand_outbox(env, ob, when_e), replies, subs, ticks]
         )
         st = _insert(st, env, cand)
 
         # --- local clocks + completion bookkeeping ---
         acted = jnp.concatenate([acted_p, has_c])
-        lc_new = jnp.where(acted, T, st.lc)
+        lc_new = jnp.where(acted, jnp.concatenate([when_e, T[n:]]), st.lc)
         clients_done = st.c_done.sum()
         newly_all = (clients_done >= C) & ~st.all_done
         # a done client never acts again, so its lc is its completion

@@ -217,7 +217,19 @@ def _engine_fingerprint(pt0, C: int) -> Dict[str, int]:
         max_seq = min(total_cmds, max(24, 3 * C))
     else:
         max_seq = total_cmds
-    return {"max_seq": int(max_seq)}
+    # any observable-contract difference must invalidate stale buckets, not
+    # just the ring window: the engine-contract version (bumped on tie-key /
+    # drain / eligibility changes, engine/lockstep.py ENGINE_CONTRACT) and
+    # the effective loop-discipline env overrides are part of the identity
+    from ..engine.lockstep import ENGINE_CONTRACT
+
+    return {
+        "max_seq": int(max_seq),
+        "contract": int(ENGINE_CONTRACT),
+        "exact": 1 if os.environ.get("FANTOCH_EXACT") else 0,
+        "row_loop": os.environ.get("FANTOCH_ROW_LOOP", ""),
+        "fold": os.environ.get("FANTOCH_FOLD", "1"),
+    }
 
 
 def run_grid(

@@ -93,6 +93,31 @@ def test_lookahead_matches_exact(proto, open_loop):
     assert int(b.iters) < int(a.iters)
 
 
+def test_fold_matches_single_pop():
+    """Silent-prefix run folding (FANTOCH_FOLD>1) must be observably
+    identical to the single-pop lookahead contract AND to the exact loop —
+    it may only change which trip consumes an event, never any observable.
+    Small shape keeps this in the default tier; the heavy A/B cases above
+    cover the bigger shapes at FOLD=1."""
+    from fantoch_tpu.protocols import basic
+
+    a = run_once(basic, exact=True, cmds=6)
+    os.environ["FANTOCH_FOLD"] = "4"
+    try:
+        b = run_once(basic, exact=False, cmds=6)
+    finally:
+        os.environ.pop("FANTOCH_FOLD", None)
+    c = run_once(basic, exact=False, cmds=6)
+    assert bool(a.all_done) and bool(b.all_done)
+    for ref in (a, c):
+        np.testing.assert_array_equal(ref.lat_cnt, b.lat_cnt)
+        np.testing.assert_array_equal(ref.lat_sum, b.lat_sum)
+        np.testing.assert_array_equal(ref.hist, b.hist)
+    # folding must actually fold on this shape (consume >1 event in some
+    # trip), not agree by never engaging
+    assert int(b.iters) < int(c.iters) < int(a.iters)
+
+
 def test_row_schedules_agree():
     """The vmapped row schedule (what the TPU runs) must produce EXACTLY the
     row-loop schedule's results (what every CPU test exercises) — the link

@@ -1,0 +1,108 @@
+"""Statistical validation of the device workload generator.
+
+The reference statistically tests that the REALIZED workload matches the
+requested parameters — the conflict-rate tests over large generated command
+populations in `fantoch/src/client/workload.rs` and the audited `zipf`
+crate behind `key_gen.rs:6`. Every protocol golden in this repo depends on
+the device PRNG keygen (`core/workload.py`), so the same property is pinned
+here: generate ~1M commands on device and assert the realized conflict
+rate, read-only rate and zipf frequency shape against the requested
+parameters.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fantoch_tpu.core.workload import (
+    KeyGen,
+    Workload,
+    WorkloadConsts,
+    sample_command_keys,
+)
+
+# ~1M commands: 2048 clients x 512 commands each
+N_CLIENTS = 2048
+N_CMDS = 512
+
+
+def _generate(workload, conflict_rate, read_only_pct, seed=0):
+    """[N_CLIENTS, N_CMDS, KPC] keys + [N_CLIENTS, N_CMDS] ro flags."""
+    consts = WorkloadConsts.build(workload)
+    key = jax.random.key(seed)
+
+    def one(client, idx):
+        return sample_command_keys(
+            consts, key, client, idx,
+            jnp.int32(conflict_rate), jnp.int32(read_only_pct),
+        )
+
+    clients = jnp.arange(N_CLIENTS, dtype=jnp.int32)
+    idxs = jnp.arange(N_CMDS, dtype=jnp.int32)
+    keys, ro = jax.jit(
+        jax.vmap(lambda c: jax.vmap(lambda i: one(c, i))(idxs))
+    )(clients)
+    return np.asarray(keys), np.asarray(ro)
+
+
+@pytest.mark.parametrize("rate", [0, 2, 10, 50, 100])
+def test_conflict_pool_realized_rate(rate):
+    """Realized conflict rate (first key drawn from the shared pool) must be
+    within +-1% of the requested rate over ~1M commands (the reference's
+    conflict-rate assertions, `fantoch/src/client/workload.rs`)."""
+    pool_size = 2
+    wl = Workload(1, KeyGen.conflict_pool(rate, pool_size), 1, N_CMDS, 100)
+    keys, _ = _generate(wl, rate, 0)
+    is_pool = keys[:, :, 0] < pool_size
+    realized = float(is_pool.mean()) * 100.0
+    assert abs(realized - rate) <= 1.0, (realized, rate)
+    # non-pool draws must be the client's own unique key (key_gen.rs:96-110)
+    own = pool_size + np.arange(N_CLIENTS)[:, None]
+    np.testing.assert_array_equal(
+        keys[:, :, 0][~is_pool], np.broadcast_to(own, is_pool.shape)[~is_pool]
+    )
+
+
+@pytest.mark.parametrize("ro_pct", [0, 20, 100])
+def test_read_only_realized_rate(ro_pct):
+    wl = Workload(1, KeyGen.conflict_pool(50, 2), 1, N_CMDS, 100)
+    _, ro = _generate(wl, 50, ro_pct)
+    realized = float(ro.mean()) * 100.0
+    assert abs(realized - ro_pct) <= 1.0, (realized, ro_pct)
+
+
+def test_two_keys_distinct_and_rate_preserved():
+    """kpc=2: both key slots always distinct (the reference's rejection
+    loop, workload.rs:188-197), and the first-key conflict rate holds."""
+    pool_size = 4
+    rate = 50
+    wl = Workload(1, KeyGen.conflict_pool(rate, pool_size), 2, N_CMDS, 100)
+    keys, _ = _generate(wl, rate, 0)
+    assert (keys[:, :, 0] != keys[:, :, 1]).all()
+    realized = float((keys[:, :, 0] < pool_size).mean()) * 100.0
+    assert abs(realized - rate) <= 1.0, (realized, rate)
+
+
+@pytest.mark.parametrize("coefficient", [0.7, 1.0])
+def test_zipf_frequency_shape(coefficient):
+    """Empirical key frequencies must match the requested zipf pmf
+    (rank^-coefficient, normalized): per-key absolute error < 0.5% and the
+    head of the distribution within 3% relative error."""
+    total_keys = 64
+    wl = Workload(1, KeyGen.zipf(coefficient, total_keys), 1, N_CMDS, 100)
+    keys, _ = _generate(wl, 0, 0)
+    counts = np.bincount(keys[:, :, 0].ravel(), minlength=total_keys)
+    emp = counts / counts.sum()
+    ranks = np.arange(1, total_keys + 1, dtype=np.float64)
+    pmf = ranks ** (-coefficient)
+    pmf /= pmf.sum()
+    np.testing.assert_allclose(emp, pmf, atol=5e-3)
+    head = slice(0, 8)
+    np.testing.assert_allclose(emp[head], pmf[head], rtol=0.03)
+
+
+def test_zipf_two_keys_distinct():
+    total_keys = 64
+    wl = Workload(1, KeyGen.zipf(1.0, total_keys), 2, N_CMDS, 100)
+    keys, _ = _generate(wl, 0, 0)
+    assert (keys[:, :, 0] != keys[:, :, 1]).all()

@@ -1,0 +1,170 @@
+"""Measure the single-CPU-core baseline for bench.py's vs_baseline.
+
+The round-3 verdict flagged that `vs_baseline` divided device event counts
+(new fast-contract counting: results drain at readiness, no cleanup-tick
+fires) by a 50k/s single-core rate estimated under the OLD counting. This
+tool re-measures the denominator with IDENTICAL event definitions: the
+native C++ oracles (native/*.cpp) implement the same engine contract as
+the device loop (same messages, same drain-at-readiness, same `steps`
+counting — pinned by tests/test_native_oracle.py equality), and they are
+exactly the reference's architecture for one core: a binary-heap
+discrete-event loop popping one event at a time
+(`fantoch/src/sim/schedule.rs`, `runner.rs:233-313`).
+
+Runs the SAME config grid bench.py times on the chip, single-threaded,
+and prints per-protocol events/sec. Usage:
+
+    python tools/cpu_baseline.py [--configs 8] [--protocols tempo,atlas]
+
+(a subset of the 64/256-config grids is enough: single-core rate is
+per-config throughput, independent of grid size — the full grid is just
+the subset repeated with different seeds).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+import numpy as np
+
+import bench
+from fantoch_tpu.core import workload as workload_mod
+from fantoch_tpu.engine.lockstep import reorder_salt
+from fantoch_tpu.utils import native
+
+
+def workload_arrays(spec, env, wl):
+    """Precompute the workload key stream the graph oracles consume."""
+    import jax.numpy as jnp
+
+    consts = workload_mod.WorkloadConsts.build(wl)
+    key = jax.random.wrap_key_data(jnp.asarray(env.seed))
+    C, cmds = spec.n_clients, spec.commands_per_client
+    cids = jnp.repeat(jnp.arange(C, dtype=jnp.int32), cmds)
+    idxs = jnp.tile(jnp.arange(cmds, dtype=jnp.int32), C)
+    keys, ro = jax.vmap(
+        lambda c, i: workload_mod.sample_command_keys(
+            consts, key, c, i, env.conflict_rate, env.read_only_pct
+        )
+    )(cids, idxs)
+    return (
+        np.asarray(keys).reshape(C, cmds, 1),
+        np.asarray(ro).reshape(C, cmds).astype(np.int32),
+    )
+
+
+def env_rows(envs, i):
+    return jax.tree_util.tree_map(lambda a: np.asarray(a)[i], envs)
+
+
+def common_args(spec, env):
+    return dict(
+        n=spec.n,
+        n_clients=spec.n_clients,
+        keys_per_command=spec.keys_per_command,
+        max_seq=spec.max_seq,
+        commands_per_client=spec.commands_per_client,
+        max_res=spec.max_res,
+        extra_ms=spec.extra_ms,
+        cleanup_ms=spec.cleanup_ms,
+        max_steps=spec.max_steps,
+        dist_pp=env.dist_pp,
+        dist_pc=env.dist_pc,
+        dist_cp=env.dist_cp[:, 0],
+        client_proc=env.client_proc[:, 0],
+    )
+
+
+def graph_args(spec, env, wl):
+    keys, ro = workload_arrays(spec, env, wl)
+    return dict(
+        gc_interval_ms=20,
+        executed_ms=spec.executed_ms,
+        reorder_hash=False,
+        salt=int(np.asarray(reorder_salt(env))),
+        key_space=spec.key_space,
+        fq_mask=env.fq_mask,
+        wq_mask=env.wq_mask,
+        keys=keys,
+        read_only=ro,
+        **common_args(spec, env),
+    )
+
+
+def run_protocol(name, n_configs):
+    """Build the bench grid for `name` and run its native oracle over
+    `n_configs` of it single-threaded. Returns (events, elapsed)."""
+    n = 3
+    if name == "basic":
+        pdef = bench.protocol_def("basic", n, None)
+        spec, wl, envs = bench.build_batch(pdef, n_configs, 100, 12,
+                                           pool_slots=384)
+        run1 = lambda spec, env: native.sim_basic_oracle(
+            fq_size=int(env.fq_size), fq_mask=env.fq_mask,
+            gc_interval_ms=20, **common_args(spec, env),
+        )
+    elif name == "fpaxos":
+        pdef = bench.protocol_def("fpaxos", n, None)
+        spec, wl, envs = bench.build_batch(pdef, n_configs, 25, None,
+                                           pool_slots=384, leader=1)
+        run1 = lambda spec, env: native.sim_fpaxos_oracle(
+            wq_size=int(env.wq_size), leader=int(env.leader),
+            wq_mask=env.wq_mask, gc_interval_ms=20, **common_args(spec, env),
+        )
+    elif name in ("tempo", "atlas", "epaxos"):
+        pdef = bench.protocol_def(name, n, None)
+        spec, wl, envs = bench.build_batch(pdef, n_configs, 25, 12,
+                                           pool_slots=384)
+        if name == "tempo":
+            run1 = lambda spec, env: native.sim_tempo_oracle(
+                fq_minority=n // 2, stability_threshold=int(env.threshold),
+                wq_size=int(env.wq_size), **graph_args(spec, env, wl),
+            )
+        else:
+            variant = 0 if name == "atlas" else 1
+            run1 = lambda spec, env, v=variant: native.sim_atlas_oracle(
+                variant=v, wq_size=int(env.wq_size),
+                **graph_args(spec, env, wl),
+            )
+    else:
+        raise ValueError(name)
+
+    native.load()  # build off the clock
+    events, elapsed = 0, 0.0
+    for i in range(n_configs):
+        env = env_rows(envs, i)
+        t0 = time.time()
+        out = run1(spec, env)
+        elapsed += time.time() - t0
+        events += out["steps"]
+    return events, elapsed
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", type=int, default=8)
+    ap.add_argument("--protocols",
+                    default="basic,tempo,atlas,epaxos,fpaxos")
+    args = ap.parse_args(argv)
+    out = {}
+    for name in args.protocols.split(","):
+        events, elapsed = run_protocol(name, args.configs)
+        rate = events / max(elapsed, 1e-9)
+        out[name] = {
+            "configs": args.configs,
+            "events": events,
+            "wall_s": round(elapsed, 2),
+            "events_per_sec": round(rate, 1),
+        }
+        print(f"{name}: {events} events / {elapsed:.2f}s = {rate:,.0f} ev/s "
+              f"(single core)", file=sys.stderr, flush=True)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
