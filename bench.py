@@ -68,8 +68,12 @@ def _load_cpu_baseline():
     try:
         with open(path) as f:
             data = json.load(f)
-        for name, rec in data.items():
-            CPU_BASELINE_EVENTS_PER_SEC[name] = float(rec["events_per_sec"])
+        # validate fully before publishing: a partially-applied file would
+        # mix measured and estimated denominators without saying so
+        loaded = {
+            name: float(rec["events_per_sec"]) for name, rec in data.items()
+        }
+        CPU_BASELINE_EVENTS_PER_SEC.update(loaded)
     except (OSError, ValueError, KeyError, TypeError) as e:
         print(
             f"bench: BASELINE_CPU.json unavailable ({e!r}); falling back to"

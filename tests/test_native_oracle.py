@@ -198,11 +198,15 @@ def _run_graph_engine(pdef, n, f, cregions, cpr, cmds, window, conflict,
     st = jax.jit(lockstep.make_run(spec, pdef, workload))(env)
     st = jax.tree_util.tree_map(np.asarray, st)
     summary.check_sim_health(st)
+    # Caesar keeps its stable counter directly on the protocol state; the
+    # graph protocols keep it inside their shared gc sub-state
+    gc_state = getattr(st.proto, "gc", None)
+    stable = gc_state.stable_count if gc_state is not None else st.proto.stable_count
     engine = {
         "lat_sum": st.lat_sum.astype(np.int64),
         "lat_cnt": st.lat_cnt,
         "commit_count": np.asarray(st.proto.commit_count),
-        "stable_count": np.asarray(st.proto.gc.stable_count),
+        "stable_count": np.asarray(stable),
         "fast_count": np.asarray(st.proto.fast_count),
         "slow_count": np.asarray(st.proto.slow_count),
         "order_hash": np.asarray(st.exec.order_hash),
@@ -377,6 +381,97 @@ def run_both_tempo(n, f, pregions, cregions, cpr, cmds, window, conflict,
         read_only=ro,
     )
     return engine, oracle
+
+
+def run_both_caesar(n, f, pregions, cregions, cpr, cmds, conflict,
+                    read_only_pct, reorder_hash, seed=0):
+    """Caesar engine vs the native predecessors oracle
+    (native/caesar_oracle.cpp): the wait condition (both blocker triage
+    outcomes), reject/retry with fresh clocks and dep unions, MUNBLOCK
+    cascades, buffered overtaking MRetry/MCommit, executed-bitmap GC and
+    the two-phase (clock, deps) predecessors executor — the round-3
+    verdict's one remaining hard kernel without an independent second
+    implementation, cross-checked end to end under both engine contracts."""
+    from fantoch_tpu.engine.lockstep import reorder_salt
+    from fantoch_tpu.protocols import caesar as caesar_proto
+    from fantoch_tpu.utils.native import sim_caesar_oracle
+
+    C = len(cregions) * cpr
+    window = C * cmds  # unwindowed: static dot space sized to the run
+    pdef = caesar_proto.make_protocol(n, 1, max_seq=window)
+    engine, spec, env, keys, ro = _run_graph_engine(
+        pdef, n, f, cregions, cpr, cmds, window, conflict, read_only_pct,
+        reorder_hash, pregions, seed,
+    )
+    oracle = sim_caesar_oracle(
+        n=n,
+        n_clients=C,
+        keys_per_command=1,
+        max_seq=spec.max_seq,
+        commands_per_client=cmds,
+        fq_size=int(env.fq_size),
+        wq_size=int(env.wq_size),
+        max_res=spec.max_res,
+        extra_ms=spec.extra_ms,
+        gc_interval_ms=100,
+        executed_ms=spec.executed_ms,
+        cleanup_ms=spec.cleanup_ms,
+        reorder_hash=reorder_hash,
+        salt=int(np.asarray(reorder_salt(env))),
+        key_space=spec.key_space,
+        max_steps=spec.max_steps,
+        dist_pp=env.dist_pp,
+        dist_pc=env.dist_pc,
+        dist_cp=env.dist_cp[:, 0],
+        client_proc=env.client_proc[:, 0],
+        fq_mask=env.fq_mask,
+        wq_mask=env.wq_mask,
+        keys=keys,
+        read_only=ro,
+    )
+    return engine, oracle
+
+
+CAESAR_CASES = [
+    # (n, f, pregions, cregions, cpr, cmds, conflict, ro%, reorder)
+    # colocated 0 ms client/process pair (us-west1), plain fast contract
+    (3, 1, ["asia-east1", "us-central1", "us-west1"],
+     ["us-west1", "us-west2"], 1, 15, 100, 0, False),
+    # exact contract under deterministic hash-reorder (overtaking commits,
+    # buffered MRetry, retry slow path all get exercised by the x[0,10)
+    # delay scramble)
+    (3, 1, ["asia-east1", "us-central1", "us-west1"],
+     ["us-west1", "us-west2"], 2, 10, 100, 20, True),
+    # 6 concurrent clients at 100% conflict under hash-reorder: probed to
+    # exercise the reject/MRetry/MRetryAck slow path (slow_count > 0), the
+    # wait condition and the unblock cascade — the error-prone kernels
+    (5, 2, ["asia-east1", "us-central1", "us-west1", "europe-west2",
+            "europe-west3"], ["asia-east1", "europe-west2"], 3, 10, 100, 0,
+     True),
+]
+
+
+@pytest.mark.parametrize(
+    "n,f,pregions,cregions,cpr,cmds,conflict,ro,reorder", CAESAR_CASES
+)
+def test_engine_matches_native_oracle_caesar(n, f, pregions, cregions, cpr,
+                                             cmds, conflict, ro, reorder):
+    engine, oracle = run_both_caesar(
+        n, f, pregions, cregions, cpr, cmds, conflict, ro, reorder,
+    )
+    np.testing.assert_array_equal(engine["lat_cnt"], oracle["lat_cnt"])
+    np.testing.assert_array_equal(engine["lat_sum"], oracle["lat_sum"])
+    np.testing.assert_array_equal(engine["commit_count"], oracle["commit_count"])
+    np.testing.assert_array_equal(engine["stable_count"], oracle["stable_count"])
+    np.testing.assert_array_equal(engine["fast_count"], oracle["fast_count"])
+    np.testing.assert_array_equal(engine["slow_count"], oracle["slow_count"])
+    # per-(process, key) rolling execution-order hashes: equality means the
+    # device pred-readiness kernel ordered every command exactly like the
+    # oracle's per-dep scan
+    np.testing.assert_array_equal(engine["order_hash"], oracle["order_hash"])
+    np.testing.assert_array_equal(engine["order_cnt"], oracle["order_cnt"])
+    np.testing.assert_array_equal(engine["c_vals"], oracle["c_vals"])
+    assert abs(engine["steps"] - oracle["steps"]) <= 16
 
 
 TEMPO_CASES = [
