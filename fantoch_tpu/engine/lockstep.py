@@ -284,6 +284,58 @@ def reorder_salt(env: "Env") -> jnp.ndarray:
     return (env.seed[0] ^ env.seed[1]).astype(jnp.uint32)
 
 
+def fast_aux(env: "Env", n: int, C: int):
+    """Static per-config lookahead structures of the fast loop.
+
+    Returns `(comp, ext, lk2c)`: the zero-distance component relation
+    over the n + C destinations ([D, D] bool, symmetric/transitive),
+    its complement, and `lk2c[s, d]` = the minimum link delay from
+    source s into destination d's component (INF_TIME when s never
+    messages any member). Computed once per `run` call (outside the trip
+    loop); module-level so tools/aux_cost.py can time it in isolation
+    (O(D^3 log D) in the n + C destination count)."""
+    INF = INF_TIME
+    DTOT = n + C
+    proc_ids = jnp.arange(n, dtype=jnp.int32)
+    half = jnp.int32((1 << 29) - 1)
+    link = jnp.full((DTOT, DTOT), INF, jnp.int32)
+    link = link.at[:n, :n].set(env.dist_pp)
+    # p -> c: only c's connected processes emit replies (_route_results)
+    connm = (
+        env.client_proc[None, :, :] == proc_ids[:, None, None]
+    ).any(axis=2)  # [n, C]
+    link = link.at[:n, n:].set(jnp.where(connm, env.dist_pc, INF))
+    # c -> p: submits go to the connected process of each shard
+    ohcp = dense.oh(env.client_proc, n)  # [C, SHARDS, n]
+    cp = jnp.min(jnp.where(ohcp, env.dist_cp[:, :, None], INF), axis=1)
+    link = link.at[n:, :n].set(cp)
+    # min-plus closure (all-pairs shortest path by repeated squaring):
+    # influence RELAYS — a commit from e can trigger p's reply to c in
+    # zero further simulated time, so the horizon must bound every
+    # multi-hop chain, not just direct links (one-hop bounds are only
+    # sound where the direct link lower-bounds all relays, which fails
+    # for clients and for triangle-inequality-violating matrices)
+    sp = jnp.minimum(link, jnp.where(jnp.eye(DTOT, dtype=jnp.bool_), 0, INF))
+    for _ in range(max(1, (DTOT - 1).bit_length())):
+        relay = jnp.min(
+            jnp.minimum(sp, half)[:, :, None]
+            + jnp.minimum(sp, half)[None, :, :],
+            axis=1,
+        )
+        sp = jnp.minimum(sp, relay)
+    # components: transitive closure of the SYMMETRIZED zero-distance
+    # relation (an equivalence partition even with one-way 0-links)
+    comp = (sp == 0) | (sp.T == 0)
+    for _ in range(max(1, (DTOT - 1).bit_length())):
+        comp = (comp.astype(jnp.int32) @ comp.astype(jnp.int32)) > 0
+    ext = ~comp
+    # min influence delay from s into any member of d's component
+    lk2c = jnp.min(
+        jnp.where(comp[None, :, :], sp[:, :, None], INF), axis=1
+    )
+    return comp, ext, lk2c
+
+
 def _tree_select(pred, a, b):
     return jax.tree_util.tree_map(lambda x, y: jnp.where(pred, x, y), a, b)
 
@@ -1342,51 +1394,7 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
     #    approximation. The reorder modes keep the tick.
 
     def _fast_aux(env: Env):
-        """Static per-config lookahead structures.
-
-        Returns `(comp, ext, lk2c)`: the zero-distance component relation
-        over the n + C destinations ([D, D] bool, symmetric/transitive),
-        its complement, and `lk2c[s, d]` = the minimum link delay from
-        source s into destination d's component (INF_TIME when s never
-        messages any member)."""
-        INF = INF_TIME
-        half = jnp.int32((1 << 29) - 1)
-        link = jnp.full((DTOT, DTOT), INF, jnp.int32)
-        link = link.at[:n, :n].set(env.dist_pp)
-        # p -> c: only c's connected processes emit replies (_route_results)
-        connm = (
-            env.client_proc[None, :, :] == proc_ids[:, None, None]
-        ).any(axis=2)  # [n, C]
-        link = link.at[:n, n:].set(jnp.where(connm, env.dist_pc, INF))
-        # c -> p: submits go to the connected process of each shard
-        ohcp = dense.oh(env.client_proc, n)  # [C, SHARDS, n]
-        cp = jnp.min(jnp.where(ohcp, env.dist_cp[:, :, None], INF), axis=1)
-        link = link.at[n:, :n].set(cp)
-        # min-plus closure (all-pairs shortest path by repeated squaring):
-        # influence RELAYS — a commit from e can trigger p's reply to c in
-        # zero further simulated time, so the horizon must bound every
-        # multi-hop chain, not just direct links (one-hop bounds are only
-        # sound where the direct link lower-bounds all relays, which fails
-        # for clients and for triangle-inequality-violating matrices)
-        sp = jnp.minimum(link, jnp.where(jnp.eye(DTOT, dtype=jnp.bool_), 0, INF))
-        for _ in range(max(1, (DTOT - 1).bit_length())):
-            relay = jnp.min(
-                jnp.minimum(sp, half)[:, :, None]
-                + jnp.minimum(sp, half)[None, :, :],
-                axis=1,
-            )
-            sp = jnp.minimum(sp, relay)
-        # components: transitive closure of the SYMMETRIZED zero-distance
-        # relation (an equivalence partition even with one-way 0-links)
-        comp = (sp == 0) | (sp.T == 0)
-        for _ in range(max(1, (DTOT - 1).bit_length())):
-            comp = (comp.astype(jnp.int32) @ comp.astype(jnp.int32)) > 0
-        ext = ~comp
-        # min influence delay from s into any member of d's component
-        lk2c = jnp.min(
-            jnp.where(comp[None, :, :], sp[:, :, None], INF), axis=1
-        )
-        return comp, ext, lk2c
+        return fast_aux(env, n, C)
 
     def _fast_row_core(ctx, proto1, exec1, has_p, kind_p, src_p, pay_p,
                        flat_p, subok_p, tmr_p, k_p, act_p, now_p, obr, obw,

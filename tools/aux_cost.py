@@ -1,0 +1,81 @@
+"""Isolate the lookahead-aux setup cost (round-3 verdict weak #7).
+
+`fast_aux` (engine/lockstep.py) builds the conservative-lookahead loop's
+static structures — the min-plus closure over the n + C destination space —
+once per `run` call, inside the jitted program, per config. Its cost is
+O(D^3 log D) with D = n + C, so the verdict asked for a measurement at
+C in {8, 32, 128} and a caching decision.
+
+This tool times, on the current default backend, a vmapped batch of
+`fast_aux` calls against one trip of the corresponding engine loop, and
+prints aux-cost-per-run as a fraction of a whole run:
+
+    python tools/aux_cost.py [--batch 64] [--trips 2000]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+import numpy as np
+
+import bench
+from fantoch_tpu.engine import lockstep, setup
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--trips", type=int, default=2000,
+                    help="representative trip count of a bench run (used to"
+                         " express aux cost as a fraction of a whole run)")
+    args = ap.parse_args(argv)
+    n = 3
+    out = {}
+    for cpr in (2, 8, 32):  # clients per region x 3 regions + auto 4-region
+        # bench placement has 3 client regions
+        placement = setup.Placement(
+            bench.PLACEMENT.process_regions,
+            bench.PLACEMENT.client_regions,
+            cpr,
+        )
+        C = len(placement.client_regions) * cpr
+        pdef = bench.protocol_def("tempo", n, None)
+        old = bench.PLACEMENT
+        bench.PLACEMENT = placement
+        try:
+            spec, wl, envs = bench.build_batch(
+                pdef, args.batch, 25, 12, pool_slots=1024,
+            )
+        finally:
+            bench.PLACEMENT = old
+        fn = jax.jit(
+            jax.vmap(lambda e: lockstep.fast_aux(e, n, C))
+        )
+        r = fn(envs)
+        jax.block_until_ready(r)  # compile
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.time()
+            jax.block_until_ready(fn(envs))
+            best = min(best, time.time() - t0)
+        out[f"C={C}"] = {
+            "batch": args.batch,
+            "aux_ms_per_run": round(best * 1e3, 3),
+            "pct_of_run_at_10ms_trips": round(
+                best / (args.trips * 0.010) * 100, 4
+            ),
+        }
+        print(f"C={C}: aux(batch {args.batch}) = {best*1e3:.2f} ms per run "
+              f"call = {best/(args.trips*0.010)*100:.3f}% of a {args.trips}"
+              f"-trip run at 10ms/trip", file=sys.stderr, flush=True)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
