@@ -29,8 +29,11 @@ from fantoch_tpu.core.workload import KeyGen, Workload
 from fantoch_tpu.engine import lockstep, setup
 
 
-def run_once(proto_mod, *, exact, open_loop=False, n=3, f=1, cmds=10,
+def run_once(proto_mod, *, exact, open_loop=False, n=3, f=1, cmds=6,
              window=None, seed=0):
+    # cmds=6 keeps every A/B equality assertion (they are shape-independent)
+    # while roughly halving the exact-loop run that dominates this file's
+    # wall time (round-4 test-tier budget, see conftest.py)
     planet = Planet.new()
     name = proto_mod.__name__.rsplit(".", 1)[-1]
     config = Config(n=n, f=f, gc_interval_ms=20,

@@ -57,6 +57,12 @@ def main(argv=None) -> int:
                          " tempo-hot, joint-10k, or 'all'")
     ap.add_argument("--joint-scale", type=float, default=1.0,
                     help="seed-axis multiplier for the joint-10k milestone")
+    ap.add_argument("--joint-seed0", type=int, default=0,
+                    help="seed-axis offset for joint-10k: the 10k grid runs"
+                         " as several seed-sliced passes because the"
+                         " tunneled remote-compile service hangs on big"
+                         " program x batch products (keep per-bucket"
+                         " batches near the proven ~80-config size)")
     ap.add_argument("--resume", action="store_true",
                     help="skip shape buckets whose results already landed"
                          " (segment-safe restarts on the flaky tunnel)")
@@ -170,13 +176,15 @@ def _milestone_grids(args):
     aws = Planet.from_dataset("aws_2021_02_13")
     aws_regions = list(aws.regions())
 
-    def pts(proto, n, f, conflicts, seeds, clients=(2,), cmds=20, **kw):
+    def pts(proto, n, f, conflicts, seeds, clients=(2,), cmds=20, seed0=0,
+            **kw):
         seeds = max(1, int(seeds * args.scale))
         return [
             Point(protocol=proto, n=n, f=f, clients_per_region=c,
                   conflict_rate=cf, pool_size=1, commands_per_client=cmds,
                   seed=s, **kw)
-            for cf in conflicts for c in clients for s in range(seeds)
+            for cf in conflicts for c in clients
+            for s in range(seed0, seed0 + seeds)
         ]
 
     grids = {
@@ -217,7 +225,8 @@ def _milestone_grids(args):
                 fs = [1] if n == 3 else [1, 2]
                 for f in fs:
                     for cf in (0, 10, 50, 100):
-                        grid += pts(proto, n, f, [cf], int(max(1, seeds)), cmds=10)
+                        grid += pts(proto, n, f, [cf], int(max(1, seeds)),
+                                    cmds=10, seed0=args.joint_seed0)
         joint.append((gcp, regions, grid))
     grids["joint-10k"] = joint
     return grids
