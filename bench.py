@@ -31,8 +31,10 @@ fault and its remote-compile service is flaky on large programs):
     backend (the CPU test suite separately pins vmap == row-loop schedules,
     tests/test_lookahead.py), so the TPU path is verified, not assumed.
 """
+import hashlib
 import json
 import os
+import platform
 import sys
 import time
 
@@ -41,11 +43,33 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import jax
 import numpy as np
 
-# persistent compile cache: a crashed attempt (the tunnel's remote-compile
-# service is flaky on large programs) does not force a fresh compile on retry
-_cache = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+# persistent compile cache, shared by the parent and every --one child so a
+# crashed attempt (the tunnel's remote-compile service is flaky on large
+# programs) does not force a fresh compile on retry. Keyed by a machine
+# fingerprint: XLA:CPU AOT entries embed host CPU features, and loading a
+# cache written on a different host spams feature-mismatch warnings and can
+# SIGILL (seen in BENCH_r03/r04 tails).
+_machine = hashlib.sha1(
+    (platform.machine() + platform.processor() + platform.node()).encode()
+).hexdigest()[:8]
+_cache = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".jax_cache", _machine
+)
 jax.config.update("jax_compilation_cache_dir", _cache)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+# Hard wall-clock budget for the WHOLE bench (seconds). The round-4 bench
+# was killed by the driver's external timeout with nothing parseable on
+# stdout (BENCH_r04.json rc=124, parsed=null); the fix is to (a) stay well
+# under any plausible driver budget and (b) print a complete, parseable
+# aggregate line after EVERY protocol so even an external kill leaves the
+# latest aggregate as the last JSON line.
+BENCH_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1080"))
+_T0 = time.time()
+
+
+def budget_left():
+    return BENCH_BUDGET_S - (time.time() - _T0)
 
 from fantoch_tpu.core.config import Config
 from fantoch_tpu.core.planet import Planet
@@ -63,6 +87,7 @@ from fantoch_tpu.engine import setup, sweep
 # native oracle yet fall back to the round-3 estimate.
 ESTIMATED_BASELINE = 50_000.0
 CPU_BASELINE_EVENTS_PER_SEC = {}  # filled from tools/cpu_baseline.py output
+BASELINE_MEASURED = False  # True iff BASELINE_CPU.json loaded cleanly
 
 
 def _load_cpu_baseline():
@@ -79,6 +104,8 @@ def _load_cpu_baseline():
             name: float(rec["events_per_sec"]) for name, rec in data.items()
         }
         CPU_BASELINE_EVENTS_PER_SEC.update(loaded)
+        global BASELINE_MEASURED
+        BASELINE_MEASURED = True
     except (OSError, ValueError, KeyError, TypeError) as e:
         print(
             f"bench: BASELINE_CPU.json unavailable ({e!r}); falling back to"
@@ -195,12 +222,17 @@ def canary(tag):
 
 def wait_healthy(tag, tries=6):
     """Block until the canary passes (60-90 s backoff per documented
-    degradation window), or give up after `tries`."""
+    degradation window), or give up after `tries` or when the backoff would
+    blow the remaining bench budget."""
     for i in range(tries):
         ok, _ = canary(tag)
         if ok:
             return True
         delay = 60 + 15 * i
+        if budget_left() < delay + 60:
+            log(f"  canary[{tag}]: degraded and only {budget_left():.0f}s of"
+                " budget left — giving up instead of backing off")
+            return False
         log(f"  waiting {delay}s for the worker to recover ({i + 1}/{tries})")
         time.sleep(delay)
     return False
@@ -281,6 +313,9 @@ def timed_run(pdef, n_configs, commands_per_client, window, chunk_steps,
     t0 = time.time()
     st = init(envs)
     while not done(st):
+        if budget_left() < 45:
+            log("  budget: aborting timed run mid-chunk (partial events kept)")
+            break
         st = chunk(envs, st)
     jax.block_until_ready(st)
     elapsed = time.time() - t0
@@ -300,6 +335,9 @@ def run_protocol(name, n_configs, commands_per_client, chunk_steps,
     attempts = 0
     while len(rates) < repeats and attempts < repeats + 3:
         attempts += 1
+        if rates and budget_left() < 120:
+            log(f"  {name}: budget low, keeping best of {len(rates)} run(s)")
+            break
         if not wait_healthy(name):
             log(f"  {name}: worker unusable, stopping retries")
             break
@@ -363,7 +401,7 @@ def run_one(name):
     rest of the bench."""
     scale = float(os.environ.get("BENCH_SCALE", "1"))
     chunk_env = os.environ.get("BENCH_CHUNK_STEPS")
-    repeats = int(os.environ.get("BENCH_REPEATS", "2"))
+    repeats = int(os.environ.get("BENCH_REPEATS", "1"))
     spec = [r for r in RUNS if r[0] == name]
     if not spec:
         print(json.dumps({"name": name, "error": "unknown protocol"}))
@@ -391,6 +429,46 @@ def run_one(name):
     return 0 if ok else 1
 
 
+def aggregate_line(per_protocol, expected, partial):
+    """One complete headline JSON line from whatever has finished so far.
+
+    `partial` marks a mid-bench incremental line; the FINAL line also
+    self-reports as partial when any expected protocol is missing or failed,
+    so a parser of the last stdout line can never mistake a truncated bench
+    for a complete one."""
+    total_events = sum(r["events"] for r in per_protocol.values())
+    total_time = sum(r["wall_s"] for r in per_protocol.values())
+    events_per_sec = total_events / max(total_time, 1e-9)
+    # aggregate vs_baseline: one CPU core sweeping the same per-protocol
+    # event mix takes sum_p(events_p / base_p) seconds; the chip took
+    # total_time — the ratio is the honest same-workload speedup
+    cpu_time = sum(
+        rec["events"] / max(rec["cpu_core_events_per_sec"], 1e-9)
+        for rec in per_protocol.values()
+    )
+    ok_names = {k for k, r in per_protocol.items() if r.get("events", 0) > 0}
+    complete = ok_names >= set(expected)
+    out = {
+        "metric": (
+            "simulated consensus events/sec/chip "
+            "(Basic/Tempo/Atlas/EPaxos/FPaxos/Caesar n=3 sweeps)"
+        ),
+        "value": round(events_per_sec, 1),
+        "unit": "events/sec",
+        "vs_baseline": round(cpu_time / max(total_time, 1e-9), 3),
+        # measured-denominator only if the file loaded AND covered every
+        # protocol in this aggregate (ADVICE r4 #4)
+        "baseline_measured": BASELINE_MEASURED
+        and not any(r.get("estimated") for r in per_protocol.values()),
+        "per_protocol": per_protocol,
+    }
+    if partial or not complete:
+        out["partial"] = True
+        out["protocols_reported"] = sorted(ok_names)
+        out["protocols_expected"] = list(expected)
+    return json.dumps(out)
+
+
 def main():
     import subprocess
 
@@ -399,22 +477,45 @@ def main():
     if only:
         keep = set(only.split(","))
         runs = [r for r in runs if r[0] in keep]
-    total_events, total_time = 0, 0.0
     per_protocol = {}
     all_ok = True
     goldens_ok = True
     me = os.path.abspath(__file__)
-    for name, _, _, _, _ in runs:
+    # reserve a slice of budget per remaining protocol so an early protocol
+    # cannot starve the rest; a child that would not fit is skipped loudly
+    for i, (name, _, _, _, _) in enumerate(runs):
+        remaining_protocols = len(runs) - i
+        left = budget_left()
+        if left < 60:
+            log(f"  {name}: SKIPPED — bench budget exhausted "
+                f"({left:.0f}s left of {BENCH_BUDGET_S:.0f}s)")
+            all_ok = False
+            continue
         rec = None
         for attempt in range(2):
+            # recompute the slice before EVERY attempt: a retry after a slow
+            # first attempt must fit the budget actually left, not the slice
+            # computed before attempt 0
+            left = budget_left()
+            if left < 60:
+                log(f"  {name}: budget exhausted before attempt {attempt}")
+                break
+            child_timeout = max(
+                min(left - 30, left / remaining_protocols * 1.8), 60
+            )
+            # the child measures its own budget from its own start time, so
+            # hand it its slice (minus a margin to print its record and exit)
+            child_env = dict(os.environ,
+                             BENCH_BUDGET_S=str(max(child_timeout - 20, 40)))
             try:
                 proc = subprocess.run(
                     [sys.executable, me, "--one", name],
-                    capture_output=True, text=True, timeout=1800,
+                    capture_output=True, text=True, timeout=child_timeout,
+                    env=child_env,
                 )
             except subprocess.TimeoutExpired:
-                log(f"  {name}: child timed out; retrying in fresh process")
-                continue
+                log(f"  {name}: child timed out after {child_timeout:.0f}s")
+                break  # no retry after a timeout: budget is the scarce thing
             sys.stderr.write(proc.stderr)
             for line in reversed(proc.stdout.splitlines()):
                 try:
@@ -426,52 +527,41 @@ def main():
                     break
             if rec and rec.get("ok"):
                 break
-            if attempt == 0:
+            if attempt == 0 and budget_left() > child_timeout / 2 + 90:
                 log(f"  {name}: child failed (rc={proc.returncode});"
                     " retrying once in a fresh process")
                 time.sleep(60)
+            else:
+                break
         if not rec:
             rec = {"name": name, "golden": False, "events": 0,
                    "wall_s": 0.0, "ok": False}
         goldens_ok &= bool(rec.get("golden"))
         all_ok &= bool(rec.get("ok"))
         events, elapsed = rec["events"], rec["wall_s"]
-        total_events += events
-        total_time += elapsed
         rate = events / max(elapsed, 1e-9)
-        base = CPU_BASELINE_EVENTS_PER_SEC.get(name, ESTIMATED_BASELINE)
+        base = CPU_BASELINE_EVENTS_PER_SEC.get(name)
         per_protocol[name] = {
             "events": events,
             "wall_s": round(elapsed, 2),
             "events_per_sec": round(rate, 1),
-            "cpu_core_events_per_sec": round(base, 1),
-            "vs_cpu_core": round(rate / base, 3),
+            "cpu_core_events_per_sec": round(
+                base if base is not None else ESTIMATED_BASELINE, 1),
+            "vs_cpu_core": round(
+                rate / (base if base is not None else ESTIMATED_BASELINE), 3),
         }
+        if base is None:
+            per_protocol[name]["estimated"] = True
+        # incremental aggregate: if anything kills us later, the last line on
+        # stdout is still a complete, parseable headline for what DID finish
+        if name != runs[-1][0]:
+            print(aggregate_line(per_protocol, [r[0] for r in runs],
+                                 partial=True), flush=True)
     log(f"device goldens: {'ok' if goldens_ok else 'FAILED'}")
     if not all_ok:
         print(json.dumps({"error": "simulation incomplete"}), file=sys.stderr)
-    events_per_sec = total_events / max(total_time, 1e-9)
-    # aggregate vs_baseline: one CPU core sweeping the same per-protocol
-    # event mix takes sum_p(events_p / base_p) seconds; the chip took
-    # total_time — the ratio is the honest same-workload speedup
-    cpu_time = sum(
-        rec["events"] / max(rec["cpu_core_events_per_sec"], 1e-9)
-        for rec in per_protocol.values()
-    )
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    "simulated consensus events/sec/chip "
-                    "(Basic/Tempo/Atlas/EPaxos/FPaxos/Caesar n=3 sweeps)"
-                ),
-                "value": round(events_per_sec, 1),
-                "unit": "events/sec",
-                "vs_baseline": round(cpu_time / max(total_time, 1e-9), 3),
-                "per_protocol": per_protocol,
-            }
-        )
-    )
+    print(aggregate_line(per_protocol, [r[0] for r in runs], partial=False),
+          flush=True)
 
 
 if __name__ == "__main__":

@@ -857,17 +857,42 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             row, in_axes=(0, ENV_AXES, 0, 0, 0, 0, 0, 0, 0, 0)
         )(proc_ids, env, st.proto, st.exec, has, kind, src, payload, gdot, subok)
 
-    def _client_rows(st: SimState, env: Env, has, kind, payload, now_rows):
+    def _wl_tables(env: Env):
+        """Precompute every client command's (keys, read_only) once.
+
+        `sample_command_keys` is a pure function of (seed, client, index,
+        rates), so the whole workload is loop-invariant: computing it outside
+        the while loop (same sampler, same bits) and gathering per trip
+        removes the PRNG bit-mix chains (~2k HLO ops at tempo bench shapes)
+        from every trip's critical path."""
+        cids = jnp.arange(C, dtype=jnp.int32)
+        idxs = jnp.arange(spec.commands_per_client, dtype=jnp.int32)
+        return jax.vmap(
+            lambda c: jax.vmap(
+                lambda i: workload_mod.sample_command_keys(
+                    consts,
+                    jax.random.wrap_key_data(env.seed),
+                    c,
+                    i,
+                    env.conflict_rate,
+                    env.read_only_pct,
+                )
+            )(idxs)
+        )(cids)  # keys [C, CMDS, kpc_raw], ro [C, CMDS]
+
+    def _client_rows(st: SimState, env: Env, has, kind, payload, now_rows,
+                     wl_tabs):
         """Handle one message per client (reply or open-loop tick), vmapped
         over the client axis (`now_rows` [C]: each row's instant — the
         global `now` under the exact discipline, the component instant under
         lookahead). Returns updated rows + effect records."""
         B = spec.batch_max_size
+        CMDS = spec.commands_per_client
 
         def row(cid, now, grp, cp_row, dcp_row, c_start, c_issued, c_resp,
                 c_sub_time, c_done, b_cnt, b_first_rifl, b_first_time,
                 b_keys, b_ro, c_batch_count, lat_sum, lat_cnt,
-                has_c, kind_c, pay_c):
+                has_c, kind_c, pay_c, wk_row, wr_row):
             is_reply = has_c & (kind_c == KIND_TO_CLIENT)
             is_tick = has_c & (kind_c == KIND_TICK)
 
@@ -880,15 +905,13 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             tick_valid = jnp.bool_(False)
 
             def sample(idx):
-                keys, ro = workload_mod.sample_command_keys(
-                    consts,
-                    jax.random.wrap_key_data(env.seed),
-                    cid,
-                    idx,
-                    env.conflict_rate,
-                    env.read_only_pct,
+                # one-hot read from the precomputed tables; out-of-range
+                # indexes (only ever produced masked-off) read 0, which is
+                # never observed
+                return (
+                    dense.dget(wk_row, idx),
+                    dense.dget(wr_row, idx).astype(jnp.bool_),
                 )
-                return keys, ro
 
             def pad_key_slots(keys):
                 kl = [keys[i] for i in range(keys.shape[0])]
@@ -1021,6 +1044,7 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
                     st.b_keys[cid], st.b_ro[cid], st.c_batch_count[cid],
                     st.lat_sum[cid], st.lat_cnt[cid],
                     has[cid], kind[cid], payload[cid],
+                    wl_tabs[0][cid], wl_tabs[1][cid],
                 )
 
                 def active(_, args=args):
@@ -1045,6 +1069,7 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
                 st.b_cnt, st.b_first_rifl, st.b_first_time, st.b_keys, st.b_ro,
                 st.c_batch_count, st.lat_sum, st.lat_cnt,
                 has, kind, payload,
+                wl_tabs[0], wl_tabs[1],
             )
         (c_start, c_issued, c_resp, c_sub_time, c_done, b_cnt, b_first_rifl,
          b_first_time, b_keys, b_ro, c_batch_count, lat_sum, lat_cnt,
@@ -1124,7 +1149,7 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         blocked_sub = (st.m_kind == KIND_SUBMIT) & ~can_of_dst
         return deliv & ~blocked_sub
 
-    def _delivery_round(env: Env, st: SimState) -> SimState:
+    def _delivery_round(env: Env, wl_tabs, st: SimState) -> SimState:
         deliv = _eff_deliv(st)  # [S]
         is_procmsg = (st.m_kind == KIND_SUBMIT) | (st.m_kind >= KIND_PROTO_BASE)
 
@@ -1174,7 +1199,8 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         now_p = jnp.full((n,), st.now, jnp.int32)
         st, replies = _route_results(st, env, res, now_p)
         st, subs, ticks = _client_rows(
-            st, env, has_c, kind_c, payload_c, jnp.full((C,), st.now, jnp.int32)
+            st, env, has_c, kind_c, payload_c,
+            jnp.full((C,), st.now, jnp.int32), wl_tabs,
         )
         cand = _cat_cands([_expand_outbox(env, ob, now_p), replies, subs, ticks])
         return _insert(st, env, cand)
@@ -1647,7 +1673,7 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
           subok, tmr, kslot, act, now_p, fk_valid, fk_kind, fk_src, fk_pay,
           fk_t)
 
-    def _fast_round(env: Env, aux, st: SimState) -> SimState:
+    def _fast_round(env: Env, aux, wl_tabs, st: SimState) -> SimState:
         """One lookahead trip: every safely-advanceable component runs one
         sub-round of its own next instant (see the discipline comment
         above)."""
@@ -1914,7 +1940,8 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         # emissions carry the emitting step's instant (`when_e` == now_p
         # without folding; the last consumed step's instant with it)
         st, replies = _route_results(st, env, res, when_e)
-        st, subs, ticks = _client_rows(st, env, has_c, kind_c, payload_c, now_c)
+        st, subs, ticks = _client_rows(st, env, has_c, kind_c, payload_c,
+                                       now_c, wl_tabs)
         cand = _cat_cands(
             [_expand_outbox(env, ob, when_e), replies, subs, ticks]
         )
@@ -2105,7 +2132,7 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         )
         return st._replace(now=jnp.minimum(times.min(), st.per_next.min()))
 
-    def body(env: Env, st: SimState) -> SimState:
+    def body(env: Env, wl_tabs, st: SimState) -> SimState:
         """One flat loop trip: a delivery sub-round if anything is
         deliverable at `now`, else fire the due timers, else end the instant.
 
@@ -2135,13 +2162,13 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         if ROW_LOOP:
             return jax.lax.cond(
                 any_deliv,
-                functools.partial(_delivery_round, env),
+                functools.partial(_delivery_round, env, wl_tabs),
                 advance,
                 st,
             )
         # vmapped TPU path: lax.cond with a batched predicate lowers to
         # computing both sides; selecting explicitly keeps that obvious
-        st_d = _delivery_round(env, st)
+        st_d = _delivery_round(env, wl_tabs, st)
         st_p = _fire_periodic(env, st)
         st_e = _end_instant(env, st)
         return _tree_select(
@@ -2149,10 +2176,14 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         )
 
     def _body_for(env: Env):
+        # the workload tables are loop-invariant: traced HERE (outside the
+        # while loop), they become invariant operands of the while op — the
+        # PRNG runs once per simulation, not once per trip
+        wl_tabs = _wl_tables(env)
         if FAST:
             aux = _fast_aux(env)
-            return functools.partial(_fast_round, env, aux)
-        return functools.partial(body, env)
+            return functools.partial(_fast_round, env, aux, wl_tabs)
+        return functools.partial(body, env, wl_tabs)
 
     def run(env: Env) -> SimState:
         return jax.lax.while_loop(cond, _body_for(env), init_state(env))

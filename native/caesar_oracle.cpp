@@ -738,7 +738,7 @@ struct CaesarSim {
       case C_MCOMMIT: h_mcommit(p, src, ev.payload); break;
       case C_MRETRY: h_mretry(p, src, ev.payload); break;
       case C_MRETRYACK: h_mretryack(p, src, ev.payload); break;
-      case C_MUNBLOCK: h_munblock(p); drain_and_route(p); break;
+      case C_MUNBLOCK: h_munblock(p); break;
       case C_MGC: h_mgc(p, src, ev.payload); break;
     }
   }

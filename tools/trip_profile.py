@@ -1,0 +1,104 @@
+"""Per-trip cost decomposition of the lockstep engine on the live chip.
+
+For a protocol at the bench shapes, measures wall time per while-loop trip
+at several batch sizes and fits `trip_time = fixed + marginal * B`, plus
+events/config/trip — the three numbers that bound the engine's events/sec:
+
+    rate(B) = B * events_per_config_per_trip / (fixed + marginal * B)
+
+Also reports the compiled HLO op count of the chunk program (a proxy for
+serialized-kernel count, the source of `fixed`). This is the measurement
+harness behind BASELINE.md's fixed-cost analysis and the round-5 lever
+selection (VERDICT r4 weak #2 / next #2).
+
+Usage:  python tools/trip_profile.py [tempo] [--batches 64,256,1024]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+import bench
+from fantoch_tpu.engine import sweep
+
+
+def measure(name, batches, trips=400):
+    pdef, window, leader = bench.build_protocol(name, 25)
+    out = {}
+    for B in batches:
+        spec, wl, envs = bench.build_batch(
+            pdef, B, 25, window, pool_slots=384, leader=leader
+        )
+        from fantoch_tpu.engine.lockstep import make_engine
+
+        eng = make_engine(spec, pdef, wl)
+        init = jax.jit(jax.vmap(eng.init_state))
+        # fixed-trip chunk: run exactly `trips` trips by bounding steps high
+        # and trips via iters is not exposed; instead run a step-bounded
+        # chunk twice and count (iters, steps) actually executed
+        chunk = jax.jit(
+            jax.vmap(lambda env, st: eng.run_chunk(env, st, trips))
+        )
+        st0 = init(envs)
+        jax.block_until_ready(st0)
+        compiled = chunk.lower(envs, st0).compile()
+        try:
+            ca = compiled.cost_analysis()
+            flops = ca.get("flops", -1)
+        except Exception:
+            flops = -1
+        hlo_ops = compiled.as_text().count("\n")
+        st1 = chunk(envs, st0)  # warm (already compiled; primes caches)
+        jax.block_until_ready(st1)
+        t0 = time.time()
+        st2 = chunk(envs, st1)
+        jax.block_until_ready(st2)
+        dt = time.time() - t0
+        it0 = int(np.asarray(st1.iters).max())
+        it1 = int(np.asarray(st2.iters).max())
+        ev = int(np.asarray(st2.step).sum() - np.asarray(st1.step).sum())
+        ntrips = it1 - it0
+        out[B] = {
+            "trips": ntrips,
+            "events": ev,
+            "wall_s": round(dt, 4),
+            "ms_per_trip": round(dt / max(ntrips, 1) * 1e3, 3),
+            "events_per_config_per_trip": round(ev / max(ntrips, 1) / B, 3),
+            "events_per_sec": round(ev / dt, 1),
+            "hlo_lines": hlo_ops,
+            "flops_per_call": flops,
+        }
+        print(f"{name} B={B}: {out[B]}", file=sys.stderr, flush=True)
+    bs = sorted(out)
+    if len(bs) >= 2:
+        b0, b1 = bs[0], bs[-1]
+        m0, m1 = out[b0]["ms_per_trip"], out[b1]["ms_per_trip"]
+        marginal = (m1 - m0) / (b1 - b0)
+        fixed = m0 - marginal * b0
+        out["fit"] = {
+            "fixed_ms_per_trip": round(fixed, 3),
+            "marginal_us_per_config_per_trip": round(marginal * 1e3, 3),
+        }
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("protocols", nargs="*", default=["tempo"])
+    ap.add_argument("--batches", default="64,256,1024")
+    ap.add_argument("--trips", type=int, default=400)
+    args = ap.parse_args()
+    protos = args.protocols or ["tempo"]
+    batches = [int(x) for x in args.batches.split(",")]
+    res = {p: measure(p, batches, args.trips) for p in protos}
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()
