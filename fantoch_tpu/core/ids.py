@@ -30,6 +30,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from ..ops import dense
+
 # low bits holding (sequence - 1): 2^21 sequences per coordinator per run,
 # up to 2^10 coordinators, inside one int32
 GSEQ_BITS = 21
@@ -82,6 +84,10 @@ def advance_frontiers(frontier_row, vdot_row, done_row, n: int, window: int):
     fr = frontier_row[:, None]
     sl = coords * window + (fr + j) % window  # [n, W]
     g = dot_make(coords, fr + 1 + j)
-    can = (vdot_row[sl] == g) & done_row[sl]  # [n, W]
+    # one-hot reads, not gathers: batched-index gathers serialize per index
+    # on TPU (ops/dense.py header) and this runs on every executor advance
+    can = (dense.dget(vdot_row, sl) == g) & (
+        dense.dget(done_row, sl).astype(jnp.bool_)
+    )  # [n, W]
     adv = jnp.cumprod(can.astype(jnp.int32), axis=1).sum(axis=1)
     return frontier_row + adv

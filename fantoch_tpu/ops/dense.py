@@ -126,6 +126,71 @@ def dadd_many(x: jnp.ndarray, i, v) -> jnp.ndarray:
     return x + contrib
 
 
+def _axis_mask(x: jnp.ndarray, idx) -> jnp.ndarray:
+    """Broadcastable bool mask selecting x[idx] for a tuple of scalar
+    indices (None/slice(None) entries keep the axis)."""
+    m = jnp.ones((1,) * x.ndim, jnp.bool_)
+    for a, i in enumerate(idx):
+        if i is None or isinstance(i, slice):
+            continue
+        shape = [1] * x.ndim
+        shape[a] = x.shape[a]
+        m = m & oh(i, x.shape[a]).reshape(shape)
+    return m
+
+
+def _expand_value(x: jnp.ndarray, idx, v) -> jnp.ndarray:
+    """Align `v` (shaped like the non-indexed axes of x, in order) to x's
+    rank by inserting singleton dims at each scalar-indexed axis."""
+    v = jnp.asarray(v, x.dtype)
+    want = x.ndim - sum(
+        1 for i in idx if not (i is None or isinstance(i, slice))
+    )
+    if v.ndim > want:
+        raise ValueError(f"value rank {v.ndim} exceeds kept axes {want}")
+    for a in range(x.ndim):
+        if a < len(idx) and not (idx[a] is None or isinstance(idx[a], slice)):
+            if v.ndim < x.ndim:
+                v = jnp.expand_dims(v, a)
+    return v
+
+
+def aget(x: jnp.ndarray, *idx) -> jnp.ndarray:
+    """`x[idx]` for scalar (traced) indices via one-hot reduction — the
+    gather-free replacement for `x[p, sl]`-style reads on the hot path.
+    None/slice(None) entries keep their axis. Out-of-range indices read 0
+    (NOT the clamp semantics of jnp indexing — callers on the hot path index
+    in-window by construction)."""
+    m = _axis_mask(x, idx)
+    axes = tuple(
+        a for a, i in enumerate(idx)
+        if not (i is None or isinstance(i, slice))
+    )
+    r = jnp.sum(jnp.where(m, x, 0), axis=axes)
+    return r.astype(x.dtype) if x.dtype == jnp.bool_ else r
+
+
+def aset(x: jnp.ndarray, idx, v, where=None, op: str = "set") -> jnp.ndarray:
+    """`x.at[idx].{set,add,max,or}(v)` via one-hot select — the scatter-free
+    replacement for per-dot state writes. `idx` is a tuple of scalar traced
+    indices (None/slice(None) keeps an axis); `v` is shaped like the kept
+    axes; `where` (scalar or broadcastable bool) gates the write; OOB
+    indices write nothing."""
+    m = _axis_mask(x, idx)
+    if where is not None:
+        m = m & where
+    ev = _expand_value(x, idx, v)
+    if op == "set":
+        return jnp.where(m, ev, x)
+    if op == "add":
+        return x + jnp.where(m, ev, jnp.zeros((), x.dtype))
+    if op == "max":
+        return jnp.maximum(x, jnp.where(m, ev, jnp.iinfo(x.dtype).min))
+    if op == "or":
+        return x | (m & ev.astype(jnp.bool_))
+    raise ValueError(op)
+
+
 def dset_many(x: jnp.ndarray, i, v, valid) -> jnp.ndarray:
     """x.at[i].set(v) for batched DISTINCT indices i [R], values v [R, ...],
     validity mask [R]. Distinctness is the caller's contract (e.g. dot slots

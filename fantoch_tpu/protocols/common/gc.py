@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 
 from ...core import ids
+from ...ops import dense
 
 _INF = jnp.int32(2**30)
 
@@ -85,17 +86,17 @@ def gc_commit(gc: GCTrack, p, dot, enable, window: int) -> GCTrack:
     `cdot`'s generation tag keeps a stale (not-yet-recycled) occupant from
     aliasing as the probed sequence."""
     sl = ids.dot_slot(dot, window)
-    cdot = gc.cdot.at[p, sl].set(jnp.where(enable, dot, gc.cdot[p, sl]))
+    cdot = dense.aset(gc.cdot, (p, sl), dot, where=enable)
     a = ids.dot_proc(dot)
-    fr0 = gc.frontier[p, a]
+    fr0 = dense.aget(gc.frontier, p, a)
     j = jnp.arange(window, dtype=jnp.int32)  # [W]
-    probe = cdot[p, a * window + (fr0 + j) % window] == ids.dot_make(
-        a, fr0 + 1 + j
-    )
+    probe = dense.dget(
+        dense.aget(cdot, p), a * window + (fr0 + j) % window
+    ) == ids.dot_make(a, fr0 + 1 + j)
     fr = fr0 + jnp.cumprod(probe.astype(jnp.int32)).sum()
     return gc._replace(
         cdot=cdot,
-        frontier=gc.frontier.at[p, a].set(jnp.where(enable, fr, fr0)),
+        frontier=dense.aset(gc.frontier, (p, a), fr, where=enable),
     )
 
 
@@ -103,12 +104,13 @@ def gc_note_exec(gc: GCTrack, p, exec_frontier_row: jnp.ndarray) -> GCTrack:
     """Fold the paired executor's contiguous executed frontier (per
     coordinator) into the report — the `Executor::executed` →
     `Protocol::handle_executed` channel (`fantoch/src/executor/mod.rs:74-82`)."""
-    old = gc.exec_frontier[p]
+    old = dense.aget(gc.exec_frontier, p)
     return gc._replace(
-        exec_frontier=gc.exec_frontier.at[p].set(
+        exec_frontier=dense.aset(
+            gc.exec_frontier, (p,),
             # INF marks "never reported" (execution == commit); frontiers
             # only grow once reporting starts
-            jnp.where(old == _INF, exec_frontier_row, jnp.maximum(old, exec_frontier_row))
+            jnp.where(old == _INF, exec_frontier_row, jnp.maximum(old, exec_frontier_row)),
         )
     )
 
@@ -116,12 +118,14 @@ def gc_note_exec(gc: GCTrack, p, exec_frontier_row: jnp.ndarray) -> GCTrack:
 def gc_report_row(gc: GCTrack, p) -> jnp.ndarray:
     """Frontier payload of a periodic `MGarbageCollection` broadcast:
     committed-and-executed contiguous prefix per coordinator."""
-    return jnp.minimum(gc.frontier[p], gc.exec_frontier[p])
+    return jnp.minimum(
+        dense.aget(gc.frontier, p), dense.aget(gc.exec_frontier, p)
+    )
 
 
 def gc_stable_row(gc: GCTrack, p) -> jnp.ndarray:
     """Stable-watermark payload of the same broadcast (window floors)."""
-    return gc.stable_wm[p]
+    return dense.aget(gc.stable_wm, p)
 
 
 def clear_window_mask(old_wm: jnp.ndarray, new_wm: jnp.ndarray, window: int) -> jnp.ndarray:
@@ -149,29 +153,37 @@ def gc_handle_mgc(
     group); defaults to every process."""
     n = gc.clock_of.shape[1]
     gc = gc._replace(
-        clock_of=gc.clock_of.at[p, src].set(jnp.maximum(gc.clock_of[p, src], frontier_in)),
-        heard_from=gc.heard_from.at[p, src].set(True),
-        stable_of=gc.stable_of.at[p, src].set(
-            jnp.maximum(gc.stable_of[p, src], stable_in)
+        clock_of=dense.aset(
+            gc.clock_of, (p, src),
+            jnp.maximum(dense.aget(gc.clock_of, p, src), frontier_in),
+        ),
+        heard_from=dense.aset(gc.heard_from, (p, src), True),
+        stable_of=dense.aset(
+            gc.stable_of, (p, src),
+            jnp.maximum(dense.aget(gc.stable_of, p, src), stable_in),
         ),
     )
     me = p if pid is None else pid
     others = jnp.arange(n) != me
     if peers_mask is not None:
         others = others & (((peers_mask >> jnp.arange(n)) & 1) == 1)
-    all_heard = jnp.where(others, gc.heard_from[p], True).all()
-    peer_min = jnp.where(others[:, None], gc.clock_of[p], _INF).min(axis=0)
-    own = jnp.minimum(gc.frontier[p], gc.exec_frontier[p])
+    all_heard = jnp.where(others, dense.aget(gc.heard_from, p), True).all()
+    peer_min = jnp.where(
+        others[:, None], dense.aget(gc.clock_of, p), _INF
+    ).min(axis=0)
+    own = jnp.minimum(
+        dense.aget(gc.frontier, p), dense.aget(gc.exec_frontier, p)
+    )
     stable = jnp.minimum(own, peer_min)
-    old_wm = gc.stable_wm[p]
+    old_wm = dense.aget(gc.stable_wm, p)
     new_wm = jnp.where(
         all_heard, jnp.maximum(old_wm, stable), old_wm
     )  # never go backwards
     gained = (new_wm - old_wm).sum()
     cleared = clear_window_mask(old_wm, new_wm, window)
     gc = gc._replace(
-        stable_wm=gc.stable_wm.at[p].set(new_wm),
-        stable_count=gc.stable_count.at[p].add(gained),
+        stable_wm=dense.aset(gc.stable_wm, (p,), new_wm),
+        stable_count=dense.aset(gc.stable_count, (p,), gained, op="add"),
     )
     return gc, cleared
 

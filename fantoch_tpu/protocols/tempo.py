@@ -52,6 +52,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core import ids
+from ..ops import dense
 from ..engine.types import (
     ExecOut,
     ProtocolDef,
@@ -221,28 +222,35 @@ def make_protocol(
         ss, es = [], []
         for i in range(KPC):
             k = keys[i]
-            old = clocks[p, k]
+            old = dense.aget(clocks, p, k)
             votes = enable & (old < up_to)
             if slot_en is not None:
                 votes = votes & slot_en[i]
             ss.append(jnp.where(votes, old + 1, 0))
             es.append(jnp.where(votes, up_to, 0))
-            clocks = clocks.at[p, k].set(jnp.where(votes, up_to, old))
+            clocks = dense.aset(clocks, (p, k), up_to, where=votes)
         return st._replace(clocks=clocks), jnp.stack(ss), jnp.stack(es)
 
     def _proposal(ctx, st: TempoState, p, dot, min_clock, enable):
         """KeyClocks::proposal — clock = max(min_clock, cur+1) (no bump for
         NFR-allowed reads), votes = the bumped ranges per key. Only the
         handling process's own shard's key slots participate."""
-        keys = ctx.cmds.keys[ids.dot_slot(dot, ctx.spec.max_seq)]
+        keys = dense.aget(ctx.cmds.keys, ids.dot_slot(dot, ctx.spec.max_seq))
         mask = _slot_mask(ctx, dot)
         cur = jnp.int32(0)
         for i in range(KPC):
-            cur = jnp.maximum(cur, jnp.where(mask[i], st.clocks[p, keys[i]], 0))
+            cur = jnp.maximum(
+                cur, jnp.where(mask[i], dense.aget(st.clocks, p, keys[i]), 0)
+            )
         bump = jnp.int32(1)
         if nfr and KPC == 1:
             bump = jnp.where(
-                ctx.cmds.read_only[ids.dot_slot(dot, ctx.spec.max_seq)], 0, 1
+                dense.aget(
+                    ctx.cmds.read_only,
+                    ids.dot_slot(dot, ctx.spec.max_seq),
+                ),
+                0,
+                1,
             )
         clock = jnp.maximum(min_clock, cur + bump)
         st, ss, es = _vote_up_to(st, p, keys, clock, enable, slot_en=mask)
@@ -253,7 +261,7 @@ def make_protocol(
         them eagerly as MDETACHED broadcast rows — or, with
         `buffer_detached`, just advance the clocks: the votes stay pending
         until the SendDetached periodic ships a covering range per key."""
-        keys = ctx.cmds.keys[ids.dot_slot(dot, ctx.spec.max_seq)]
+        keys = dense.aget(ctx.cmds.keys, ids.dot_slot(dot, ctx.spec.max_seq))
         st, ss, es = _vote_up_to(st, p, keys, up_to, enable,
                                  slot_en=_slot_mask(ctx, dot))
         if buffer_detached:
@@ -264,17 +272,21 @@ def make_protocol(
                 [keys[i], ss[i], es[i]],
             )
         st = st._replace(
-            detached_sent=st.detached_sent.at[p].add((ss > 0).sum())
+            detached_sent=dense.aset(
+                st.detached_sent, (p,), (ss > 0).sum(), op="add"
+            )
         )
         return st, ob
 
     def _mcommit_payload(votes_s, votes_e, p, dot, sl, clock):
         """MCommit wire layout: [dot, clock, (start,end) x KPC x n] —
         decoded by h_mcommit's stride-2 slices."""
+        vs = dense.aget(votes_s, p, sl)  # [KPC, n], one one-hot read
+        ve = dense.aget(votes_e, p, sl)
         payload = [dot, clock]
         for k in range(KPC):
             for v in range(n):
-                payload += [votes_s[p, sl, k, v], votes_e[p, sl, k, v]]
+                payload += [vs[k, v], ve[k, v]]
         return payload
 
     # ------------------------------------------------------------------
@@ -286,18 +298,19 @@ def make_protocol(
         infos, bump `max_commit_clock`, generate detached votes, track GC."""
         sl = ids.dot_slot(dot, ctx.spec.max_seq)
         st = st._replace(
-            status=st.status.at[p, sl].set(
-                jnp.where(enable, COMMIT, st.status[p, sl])
-            ),
-            max_commit_clock=st.max_commit_clock.at[p].max(
-                jnp.where(enable, clock, 0)
+            status=dense.aset(st.status, (p, sl), COMMIT, where=enable),
+            max_commit_clock=dense.aset(
+                st.max_commit_clock, (p,), jnp.where(enable, clock, 0),
+                op="max",
             ),
             synod=st.synod._replace(
-                acc_val=st.synod.acc_val.at[p, sl].set(
-                    jnp.where(enable, clock, st.synod.acc_val[p, sl])
+                acc_val=dense.aset(
+                    st.synod.acc_val, (p, sl), clock, where=enable
                 )
             ),
-            commit_count=st.commit_count.at[p].add(enable.astype(jnp.int32)),
+            commit_count=dense.aset(
+                st.commit_count, (p,), enable.astype(jnp.int32), op="add"
+            ),
             gc=gc_mod.gc_commit(
                 st.gc, p, dot,
                 enable & sharding.own_coord(ctx, dot, shards),
@@ -351,20 +364,26 @@ def make_protocol(
         sl = ids.dot_slot(dot, ctx.spec.max_seq)
         st = st._replace(
             key_count_hist=hist_add(
-                st.key_count_hist, p, distinct_count(ctx.cmds.keys[sl]), True
+                st.key_count_hist, p,
+                distinct_count(dense.aget(ctx.cmds.keys, sl)), True,
             )
         )
         st, clock, ss, es = _proposal(ctx, st, p, dot, jnp.int32(0), jnp.bool_(True))
         # store coordinator votes for later aggregation (tempo.rs:297-310)
         st = st._replace(
-            votes_s=st.votes_s.at[p, sl, :, ctx.pid].set(ss),
-            votes_e=st.votes_e.at[p, sl, :, ctx.pid].set(es),
+            votes_s=dense.aset(
+                st.votes_s, (p, sl, slice(None), ctx.pid), ss
+            ),
+            votes_e=dense.aset(
+                st.votes_e, (p, sl, slice(None), ctx.pid), es
+            ),
         )
         # NFR single-key reads use a plain majority as the fast quorum
         # (BaseProcess::maybe_adjust_fast_quorum)
         if nfr and KPC == 1:
             qmask = jnp.where(
-                ctx.cmds.read_only[sl], ctx.env.maj_mask[p], ctx.env.fq_mask[p]
+                dense.aget(ctx.cmds.read_only, sl),
+                ctx.env.maj_mask[p], ctx.env.fq_mask[p],
             )
         else:
             qmask = ctx.env.fq_mask[p]
@@ -397,13 +416,18 @@ def make_protocol(
         sl = ids.dot_slot(dot, ctx.spec.max_seq)
         st = st._replace(
             key_count_hist=hist_add(
-                st.key_count_hist, p, distinct_count(ctx.cmds.keys[sl]), True
+                st.key_count_hist, p,
+                distinct_count(dense.aget(ctx.cmds.keys, sl)), True,
             )
         )
         st, clock, ss, es = _proposal(ctx, st, p, dot, jnp.int32(0), jnp.bool_(True))
         st = st._replace(
-            votes_s=st.votes_s.at[p, sl, :, ctx.pid].set(ss),
-            votes_e=st.votes_e.at[p, sl, :, ctx.pid].set(es),
+            votes_s=dense.aset(
+                st.votes_s, (p, sl, slice(None), ctx.pid), ss
+            ),
+            votes_e=dense.aset(
+                st.votes_e, (p, sl, slice(None), ctx.pid), es
+            ),
         )
         ob = outbox_row(
             empty_outbox(MAX_OUT, MSG_W), 0,
@@ -419,11 +443,11 @@ def make_protocol(
         handle_mshard_commit)."""
         dot, clock = payload[0], payload[1]
         sl = ids.dot_slot(dot, ctx.spec.max_seq)
-        cnt = st.sc_cnt[p, sl] + 1
-        mx = jnp.maximum(st.sc_max[p, sl], clock)
+        cnt = dense.aget(st.sc_cnt, p, sl) + 1
+        mx = jnp.maximum(dense.aget(st.sc_max, p, sl), clock)
         st = st._replace(
-            sc_cnt=st.sc_cnt.at[p, sl].set(cnt),
-            sc_max=st.sc_max.at[p, sl].set(mx),
+            sc_cnt=dense.aset(st.sc_cnt, (p, sl), cnt),
+            sc_max=dense.aset(st.sc_max, (p, sl), mx),
         )
         touch = _shard_touch(ctx, dot)
         done = cnt == touch.sum()
@@ -457,7 +481,8 @@ def make_protocol(
         dot, rclock, qmask = payload[0], payload[1], payload[2]
         sl = ids.dot_slot(dot, ctx.spec.max_seq)
         live = gc_mod.gc_live(st.gc, p, dot)
-        is_start = live & (st.status[p, sl] == START)
+        status_sl = dense.aget(st.status, p, sl)
+        is_start = live & (status_sl == START)
         in_q = bit(qmask, ctx.pid) == 1
         from_self = src == ctx.pid
 
@@ -472,15 +497,12 @@ def make_protocol(
         for i in range(n):
             qsz = qsz + bit(qmask, jnp.int32(i))
         st = st._replace(
-            status=st.status.at[p, sl].set(
-                jnp.where(
-                    is_start,
-                    jnp.where(in_q, COLLECT, PAYLOAD),
-                    st.status[p, sl],
-                )
+            status=dense.aset(
+                st.status, (p, sl), jnp.where(in_q, COLLECT, PAYLOAD),
+                where=is_start,
             ),
-            qmask=st.qmask.at[p, sl].set(jnp.where(q_en, qmask, st.qmask[p, sl])),
-            qsize=st.qsize.at[p, sl].set(jnp.where(q_en, qsz, st.qsize[p, sl])),
+            qmask=dense.aset(st.qmask, (p, sl), qmask, where=q_en),
+            qsize=dense.aset(st.qsize, (p, sl), qsz, where=q_en),
             synod=synod_mod.set_if_not_accepted(st.synod, p, sl, clk, q_en),
         )
         ack_payload = [dot, clk]
@@ -499,10 +521,10 @@ def make_protocol(
             rsm = jnp.zeros((KPC, n), jnp.int32)
             rem = jnp.zeros((KPC, n), jnp.int32)
             for i in range(KPC):
-                rsm = rsm.at[i, src].set(payload[3 + 2 * i])
-                rem = rem.at[i, src].set(payload[4 + 2 * i])
-                rsm = rsm.at[i, ctx.pid].set(ss[i])
-                rem = rem.at[i, ctx.pid].set(es[i])
+                rsm = dense.aset(rsm, (i, src), payload[3 + 2 * i])
+                rem = dense.aset(rem, (i, src), payload[4 + 2 * i])
+                rsm = dense.aset(rsm, (i, ctx.pid), ss[i])
+                rem = dense.aset(rem, (i, ctx.pid), es[i])
             commit_payload = [dot, clk]
             for k in range(KPC):
                 for v in range(n):
@@ -520,13 +542,18 @@ def make_protocol(
             )
         # non-quorum member: payload only; flush a buffered commit if the
         # MCommit overtook the MCollect (tempo.rs:369-387)
-        flush = is_start & ~in_q & st.bufc_valid[p, sl]
-        st = st._replace(bufc_valid=st.bufc_valid.at[p, sl].set(
-            st.bufc_valid[p, sl] & ~flush
-        ))
+        flush = is_start & ~in_q & dense.aget(st.bufc_valid, p, sl)
+        st = st._replace(
+            bufc_valid=dense.aset(
+                st.bufc_valid, (p, sl), False, where=flush
+            )
+        )
         st, ob, execout = _commit(
             ctx, st, ob, 1, p, dot,
-            st.bufc_clock[p, sl], st.bufc_s[p, sl], st.bufc_e[p, sl], flush,
+            dense.aget(st.bufc_clock, p, sl),
+            dense.aget(st.bufc_s, p, sl),
+            dense.aget(st.bufc_e, p, sl),
+            flush,
         )
         return st, ob, execout
 
@@ -534,32 +561,29 @@ def make_protocol(
         dot, clk = payload[0], payload[1]
         sl = ids.dot_slot(dot, ctx.spec.max_seq)
         live = gc_mod.gc_live(st.gc, p, dot)
-        collect = live & (st.status[p, sl] == COLLECT)
+        collect = live & (dense.aget(st.status, p, sl) == COLLECT)
 
         # merge remote votes (tempo.rs:493-495)
         votes_s, votes_e = st.votes_s, st.votes_e
         for i in range(KPC):
             s_i, e_i = payload[2 + 2 * i], payload[3 + 2 * i]
             take = collect & (s_i > 0)
-            votes_s = votes_s.at[p, sl, i, src].set(
-                jnp.where(take, s_i, votes_s[p, sl, i, src])
-            )
-            votes_e = votes_e.at[p, sl, i, src].set(
-                jnp.where(take, e_i, votes_e[p, sl, i, src])
-            )
+            votes_s = dense.aset(votes_s, (p, sl, i, src), s_i, where=take)
+            votes_e = dense.aset(votes_e, (p, sl, i, src), e_i, where=take)
 
         # QuorumClocks::add (quorum.rs:36-60)
-        old_max, old_cnt = st.qc_max[p, sl], st.qc_maxcount[p, sl]
+        old_max = dense.aget(st.qc_max, p, sl)
+        old_cnt = dense.aget(st.qc_maxcount, p, sl)
         new_max = jnp.maximum(old_max, clk)
         new_cnt = jnp.where(clk > old_max, 1, jnp.where(clk == old_max, old_cnt + 1, old_cnt))
-        count = st.qc_count[p, sl] + collect.astype(jnp.int32)
+        count = dense.aget(st.qc_count, p, sl) + collect.astype(jnp.int32)
         st = st._replace(
             votes_s=votes_s,
             votes_e=votes_e,
-            qc_count=st.qc_count.at[p, sl].set(count),
-            qc_max=st.qc_max.at[p, sl].set(jnp.where(collect, new_max, old_max)),
-            qc_maxcount=st.qc_maxcount.at[p, sl].set(
-                jnp.where(collect, new_cnt, old_cnt)
+            qc_count=dense.aset(st.qc_count, (p, sl), count),
+            qc_max=dense.aset(st.qc_max, (p, sl), new_max, where=collect),
+            qc_maxcount=dense.aset(
+                st.qc_maxcount, (p, sl), new_cnt, where=collect
             ),
         )
 
@@ -570,9 +594,10 @@ def make_protocol(
         )
 
         # all fast-quorum clocks in? (tempo.rs:524-570)
-        all_in = collect & (count == st.qsize[p, sl])
+        qsize_sl = dense.aget(st.qsize, p, sl)
+        all_in = collect & (count == qsize_sl)
         minority = ranks // 2  # a minority of this shard's replicas
-        threshold = st.qsize[p, sl] - minority
+        threshold = qsize_sl - minority
         fast = all_in & (new_cnt >= threshold)
         slow = all_in & ~(new_cnt >= threshold)
 
@@ -581,10 +606,16 @@ def make_protocol(
             synod=synod_mod.skip_prepare(
                 st.synod, p, sl, new_max, slow, pid=ctx.pid
             ),
-            fast_count=st.fast_count.at[p].add(fast.astype(jnp.int32)),
-            slow_count=st.slow_count.at[p].add(slow.astype(jnp.int32)),
-            slow_read_count=st.slow_read_count.at[p].add(
-                (slow & ctx.cmds.read_only[sl]).astype(jnp.int32)
+            fast_count=dense.aset(
+                st.fast_count, (p,), fast.astype(jnp.int32), op="add"
+            ),
+            slow_count=dense.aset(
+                st.slow_count, (p,), slow.astype(jnp.int32), op="add"
+            ),
+            slow_read_count=dense.aset(
+                st.slow_read_count, (p,),
+                (slow & dense.aget(ctx.cmds.read_only, sl)).astype(jnp.int32),
+                op="add",
             ),
         )
         ob = outbox_row(
@@ -603,25 +634,22 @@ def make_protocol(
         live = gc_mod.gc_live(st.gc, p, dot)
         rs = payload[2 : 2 + 2 * KPC * n : 2].reshape(KPC, n)
         re = payload[3 : 3 + 2 * KPC * n : 2].reshape(KPC, n)
-        is_start = live & (st.status[p, sl] == START)
+        status_sl = dense.aget(st.status, p, sl)
+        is_start = live & (status_sl == START)
         can_commit = live & (
-            (st.status[p, sl] == PAYLOAD) | (st.status[p, sl] == COLLECT)
+            (status_sl == PAYLOAD) | (status_sl == COLLECT)
         )
 
         # MCommit before MCollect: buffer it (tempo.rs:594-599)
         st = st._replace(
-            bufc_valid=st.bufc_valid.at[p, sl].set(
-                st.bufc_valid[p, sl] | is_start
+            bufc_valid=dense.aset(
+                st.bufc_valid, (p, sl), True, where=is_start
             ),
-            bufc_clock=st.bufc_clock.at[p, sl].set(
-                jnp.where(is_start, clock, st.bufc_clock[p, sl])
+            bufc_clock=dense.aset(
+                st.bufc_clock, (p, sl), clock, where=is_start
             ),
-            bufc_s=st.bufc_s.at[p, sl].set(
-                jnp.where(is_start, rs, st.bufc_s[p, sl])
-            ),
-            bufc_e=st.bufc_e.at[p, sl].set(
-                jnp.where(is_start, re, st.bufc_e[p, sl])
-            ),
+            bufc_s=dense.aset(st.bufc_s, (p, sl), rs, where=is_start),
+            bufc_e=dense.aset(st.bufc_e, (p, sl), re, where=is_start),
         )
         ob = empty_outbox(MAX_OUT, MSG_W)
         st, ob, execout = _commit(ctx, st, ob, 0, p, dot, clock, rs, re, can_commit)
@@ -643,13 +671,14 @@ def make_protocol(
         dot, ballot, clock = payload[0], payload[1], payload[2]
         sl = ids.dot_slot(dot, ctx.spec.max_seq)
         live = gc_mod.gc_live(st.gc, p, dot)
-        chosen = live & (st.status[p, sl] == COMMIT)
+        status_sl = dense.aget(st.status, p, sl)
+        chosen = live & (status_sl == COMMIT)
         ob = empty_outbox(MAX_OUT, MSG_W)
         # detached votes up to the consensus clock if we have the payload
         # (tempo.rs:756-761)
         st, ob = _detached_rows(
             ctx, st, ob, 1, p, dot, clock,
-            live & ~chosen & (st.status[p, sl] != START),
+            live & ~chosen & (status_sl != START),
         )
         sy, accepted = synod_mod.handle_accept(st.synod, p, sl, ballot, clock)
         accepted = accepted & live
@@ -661,7 +690,8 @@ def make_protocol(
         # already chosen: reply MCommit with the stored votes (tempo.rs:780-786);
         # otherwise ack the accept
         commit_payload = _mcommit_payload(
-            st.votes_s, st.votes_e, p, dot, sl, st.synod.acc_val[p, sl]
+            st.votes_s, st.votes_e, p, dot, sl,
+            dense.aget(st.synod.acc_val, p, sl),
         )
         ack_payload = [dot, ballot] + [jnp.int32(0)] * (len(commit_payload) - 2)
         pay = jnp.where(
@@ -682,7 +712,7 @@ def make_protocol(
         dot, ballot = payload[0], payload[1]
         sl = ids.dot_slot(dot, ctx.spec.max_seq)
         live = gc_mod.gc_live(st.gc, p, dot)
-        not_committed = live & (st.status[p, sl] != COMMIT)
+        not_committed = live & (dense.aget(st.status, p, sl) != COMMIT)
         sy, chosen, value = synod_mod.handle_accepted(
             st.synod, p, sl, ballot, ctx.env.wq_size, src
         )
