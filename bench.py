@@ -447,7 +447,9 @@ def aggregate_line(per_protocol, expected, partial):
         for rec in per_protocol.values()
     )
     ok_names = {k for k, r in per_protocol.items() if r.get("events", 0) > 0}
-    complete = ok_names >= set(expected)
+    # a vacuous aggregate (nothing expected or nothing reported) must never
+    # parse as a complete bench
+    complete = bool(expected) and bool(per_protocol) and ok_names >= set(expected)
     out = {
         "metric": (
             "simulated consensus events/sec/chip "
@@ -497,12 +499,13 @@ def main():
             # first attempt must fit the budget actually left, not the slice
             # computed before attempt 0
             left = budget_left()
-            if left < 60:
-                log(f"  {name}: budget exhausted before attempt {attempt}")
+            if left < 90:
+                # skip rather than floor the child budget: a 60s floor let a
+                # child overrun the parent's global budget by ~30s
+                log(f"  {name}: only {left:.0f}s of budget left — skipping"
+                    f" (attempt {attempt})")
                 break
-            child_timeout = max(
-                min(left - 30, left / remaining_protocols * 1.8), 60
-            )
+            child_timeout = min(left - 30, max(left / remaining_protocols * 1.8, 60))
             # the child measures its own budget from its own start time, so
             # hand it its slice (minus a margin to print its record and exit)
             child_env = dict(os.environ,

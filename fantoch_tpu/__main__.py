@@ -25,6 +25,25 @@ def _icsv(s: str):
     return [int(x) for x in _csv(s)]
 
 
+def _parse_crash(specs):
+    """["P@T0[:T1]", ...] -> ((proc, at_ms, recover_ms; -1 = never), ...)"""
+    out = []
+    for s in specs:
+        proc, _, window = s.partition("@")
+        t0, _, t1 = window.partition(":")
+        out.append((int(proc), int(t0), int(t1) if t1 else -1))
+    return tuple(out)
+
+
+def _parse_partition(s):
+    """"A,B,..@T0:T1" -> ((procs...), from_ms, until_ms) or ()"""
+    if not s:
+        return ()
+    grp, _, window = s.partition("@")
+    t0, _, t1 = window.partition(":")
+    return (tuple(int(x) for x in grp.split(",")), int(t0), int(t1))
+
+
 def cmd_sim(args) -> int:
     from .exp.harness import Point, run_grid
     from .plot.db import ResultsDB
@@ -64,6 +83,12 @@ def cmd_sim(args) -> int:
         skip_fast_ack=args.skip_fast_ack,
         execute_at_commit=args.execute_at_commit,
         caesar_wait_condition=not args.no_wait_condition,
+        crash=_parse_crash(args.crash),
+        partition=_parse_partition(args.partition),
+        drop_pct=args.drop_pct,
+        dup_pct=args.dup_pct,
+        leader_check_interval_ms=args.leader_check,
+        deadline_ms=args.deadline,
     )
     dirs = run_grid(
         [pt],
@@ -363,6 +388,25 @@ def main(argv=None) -> int:
     ps.add_argument("--client-regions", default="")
     ps.add_argument("--results", default="results")
     ps.add_argument("--verbose", action="store_true")
+    # fault injection (engine/faults.py): deterministic crash / partition /
+    # loss schedules, vmapped like every other Env field
+    ps.add_argument(
+        "--crash", action="append", default=[], metavar="P@T0[:T1]",
+        help="crash process P (0-based) at T0 ms, recover at T1 ms"
+        " (omit T1 for a permanent crash); repeatable",
+    )
+    ps.add_argument(
+        "--partition", default="", metavar="A,B,..@T0:T1",
+        help="partition processes A,B,.. from the rest during [T0, T1) ms",
+    )
+    ps.add_argument("--drop-pct", type=int, default=0,
+                    help="hash-drop percentage over protocol messages")
+    ps.add_argument("--dup-pct", type=int, default=0,
+                    help="hash-duplication percentage over protocol messages")
+    ps.add_argument("--leader-check", type=int, default=0,
+                    help="FPaxos leader_check interval ms (enables failover)")
+    ps.add_argument("--deadline", type=int, default=0,
+                    help="hard simulated-time stop ms (stalling schedules)")
     ps.set_defaults(fn=cmd_sim)
 
     pw = sub.add_parser("sweep", help="run a protocol x config grid")

@@ -46,6 +46,11 @@ class Config:
     # leader-based protocols (FPaxos); process ids are 1-based like the
     # reference's
     leader: Optional[int] = None
+    # leader failure detection (FPaxos failover, protocols/fpaxos.py):
+    # interval of the leader_check periodic event; None disables the whole
+    # failover machinery (the reference has none — multi.rs leaves
+    # recovery unimplemented)
+    leader_check_interval_ms: Optional[int] = None
 
     # protocol flags
     nfr: bool = False  # non-fault-tolerant reads

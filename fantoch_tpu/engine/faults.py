@@ -1,0 +1,271 @@
+"""Deterministic fault injection: crash, partition, and drop/dup schedules.
+
+The reference explicitly leaves failure handling as a TODO
+(`fantoch/src/protocol/partial.rs:74-76`); this module fills the hole the
+way training/inference-scale distributed stacks validate theirs —
+Jepsen-style *deterministic* fault schedules, expressed as pure data so a
+schedule vmaps across configs and shards under pjit like every other `Env`
+field:
+
+- **crashes**: per-process `[crash_at, recover_at)` windows. A crashed
+  process handles nothing and emits nothing; its periodic slots freeze
+  (they skip to the first multiple of their interval at or after
+  recovery); protocol/submit messages *arriving* during the window are
+  lost (the TCP-connection-reset model), while messages already delivered
+  before the crash stay handled. State survives the window — the
+  crash-recovery-with-durable-state model, equivalently a long pause.
+- **partitions**: one window `[part_from, part_until)` cutting every
+  protocol link between the `part_a` bitmask group and its complement.
+  Messages *emitted* during the window across the cut are lost.
+- **drop/dup**: hash-salted per-message loss/duplication percentages over
+  protocol messages (murmur3-finalizer of the message's engine sequence
+  number — deterministic per run, like the hash-reorder mode).
+
+Failure *detection* is perfect and instantaneous: the schedule is part of
+`Env`, so quorum selection (`dynamic_masks`) can avoid processes that are
+crashed at the handling instant — the strongest failure detector, the
+standard simplification for deterministic simulation. Commands whose
+quorums were fixed before a member crashed (the masks ride in message
+payloads) stall rather than re-form: safety over liveness, exactly the
+reference's contract.
+
+The client plane is failure-free by design: clients model workload
+generators, and replies/ticks (engine kinds) never drop. A client whose
+connected process crashes simply stalls — it is not a "surviving client".
+
+Everything here is pure and shared verbatim by the lock-step engine
+(engine/lockstep.py) and the distributed quantum runner
+(parallel/quantum.py), so the two stay observation-equal under the same
+schedule.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import dense
+from .types import INF_TIME, KIND_PROTO_BASE, KIND_SUBMIT, bit
+
+# salts folded into the env seed hash for the drop/dup lotteries (distinct
+# from each other and from the reorder salt so the three draws decorrelate)
+DROP_SALT = np.uint32(0x5EED0D20)
+DUP_SALT = np.uint32(0xD0B1E5A1)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSchedule:
+    """Host-side schedule for one configuration.
+
+    `crash` maps a 0-based global process index to `(crash_at_ms,
+    recover_at_ms)`; pass `None` as `recover_at_ms` for a permanent crash.
+    `partition` is `(group_a_indices, from_ms, until_ms)`. `drop_pct` /
+    `dup_pct` are integer percentages applied per protocol message."""
+
+    crash: Dict[int, Tuple[int, Optional[int]]] = dataclasses.field(
+        default_factory=dict
+    )
+    partition: Optional[Tuple[Sequence[int], int, int]] = None
+    drop_pct: int = 0
+    dup_pct: int = 0
+
+    def env_fields(self, n: int) -> Dict[str, np.ndarray]:
+        """The concrete `Env` arrays of this schedule for `n` processes."""
+        fields = no_fault_env_fields(n)
+        for p, (at, rec) in self.crash.items():
+            assert 0 <= p < n, f"crash process {p} out of range 0..{n - 1}"
+            fields["crash_at"][p] = int(at)
+            fields["recover_at"][p] = (
+                int(INF_TIME) if rec is None else int(rec)
+            )
+        if self.partition is not None:
+            group_a, frm, until = self.partition
+            mask = 0
+            for p in group_a:
+                assert 0 <= p < n
+                mask |= 1 << p
+            fields["part_a"] = np.int32(mask)
+            fields["part_from"] = np.int32(frm)
+            fields["part_until"] = np.int32(until)
+        fields["drop_pct"] = np.int32(self.drop_pct)
+        fields["dup_pct"] = np.int32(self.dup_pct)
+        return fields
+
+    @property
+    def any(self) -> bool:
+        return bool(
+            self.crash
+            or self.partition
+            or self.drop_pct
+            or self.dup_pct
+        )
+
+
+def no_fault_env_fields(n: int) -> Dict[str, np.ndarray]:
+    """Fault-free `Env` defaults (crashes never, no partition, 0% lottery)."""
+    return {
+        "crash_at": np.full((n,), int(INF_TIME), np.int32),
+        "recover_at": np.full((n,), int(INF_TIME), np.int32),
+        "part_a": np.int32(0),
+        "part_from": np.int32(INF_TIME),
+        "part_until": np.int32(INF_TIME),
+        "drop_pct": np.int32(0),
+        "dup_pct": np.int32(0),
+    }
+
+
+# ---------------------------------------------------------------------------
+# traceable predicates (shared by both engines)
+# ---------------------------------------------------------------------------
+
+
+def crashed_at(env, proc, t):
+    """Is process `proc` inside its crash window at time `t`? Broadcasts."""
+    c = dense.dget(env.crash_at, proc)
+    r = dense.dget(env.recover_at, proc)
+    return (jnp.asarray(t) >= c) & (jnp.asarray(t) < r)
+
+
+def crash_deferred_time(env, proc, t):
+    """Effective handling time of an event due at `t` at process `proc`:
+    events landing inside the crash window wait until recovery (used for
+    delivery eligibility / clock advancement of already-pooled messages,
+    e.g. window-deferred submits that slide into the window)."""
+    r = dense.dget(env.recover_at, proc)
+    return jnp.where(crashed_at(env, proc, t), r, jnp.asarray(t))
+
+
+def alive_matrix(env, now_rows):
+    """[n, n] bool: is column process q alive at row p's instant
+    `now_rows[p]`."""
+    t = jnp.asarray(now_rows)[:, None]
+    dead = (t >= env.crash_at[None, :]) & (t < env.recover_at[None, :])
+    return ~dead
+
+
+def _hash_pct(x, salt):
+    """murmur3-finalizer percentage draw in [0, 100) — the same bit-exact
+    arithmetic as the engine's hash-reorder multiplier."""
+    x = jnp.asarray(x).astype(jnp.uint32) ^ jnp.uint32(salt)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return (x % jnp.uint32(100)).astype(jnp.int32)
+
+
+def lottery_salt(env) -> jnp.ndarray:
+    """Per-config uint32 salt of the drop/dup lotteries."""
+    return (env.seed[0] ^ env.seed[1]).astype(jnp.uint32)
+
+
+def drop_lottery(env, msg_ids) -> jnp.ndarray:
+    """[CN] bool: hash-dropped message? (`msg_ids` = unique engine seqnos)"""
+    return _hash_pct(msg_ids, lottery_salt(env) ^ DROP_SALT) < env.drop_pct
+
+
+def dup_lottery(env, msg_ids) -> jnp.ndarray:
+    """[CN] bool: hash-duplicated message?"""
+    return _hash_pct(msg_ids, lottery_salt(env) ^ DUP_SALT) < env.dup_pct
+
+
+def candidate_drop_mask(env, n, kind, src, dst, when, arrival, msg_ids):
+    """[CN] bool: which pool-insert candidates the schedule LOSES.
+
+    `when` is the emission instant (partitions cut in-flight sends),
+    `arrival` the delivery instant (crashes reset arriving connections).
+    Only process-plane traffic faults: submits and protocol messages; the
+    client plane (replies, ticks) is failure-free by contract."""
+    is_procdst = (kind == KIND_SUBMIT) | (kind >= KIND_PROTO_BASE)
+    is_proto = kind >= KIND_PROTO_BASE
+    dstp = jnp.clip(dst, 0, n - 1)
+    # crash: arriving during the destination's window -> connection lost
+    crash_drop = is_procdst & crashed_at(env, dstp, arrival)
+    # partition: protocol messages emitted across the cut during the window
+    srcp = jnp.clip(src, 0, n - 1)
+    in_window = (when >= env.part_from) & (when < env.part_until)
+    across = (
+        (bit(env.part_a, srcp) == 1) != (bit(env.part_a, dstp) == 1)
+    )
+    part_drop = is_proto & in_window & across
+    # hash lottery over protocol messages
+    lottery = is_proto & drop_lottery(env, msg_ids)
+    return crash_drop | part_drop | lottery
+
+
+def normalize_per_next(env, per_next, interval_arr):
+    """Freeze crashed processes' periodic timers: a slot scheduled inside a
+    crash window skips to its first multiple at or after recovery (no
+    catch-up storm); permanently-crashed processes' timers go to INF.
+
+    `per_next` [n, NPER], `interval_arr` [NPER]. Idempotent — both engines
+    apply it at the top of every trip/quantum."""
+    c = env.crash_at[:, None]
+    r = env.recover_at[:, None]
+    iv = jnp.maximum(interval_arr[None, :], 1)
+    in_win = (per_next >= c) & (per_next < r)
+    k = (r - per_next + iv - 1) // iv
+    skipped = jnp.minimum(per_next + k * iv, INF_TIME)
+    return jnp.where(in_win, skipped, per_next)
+
+
+def dynamic_masks(env, n, now_rows):
+    """Quorum masks recomputed to avoid crashed processes — the perfect
+    failure detector feeding quorum selection. Returns `(fq, wq, maj)`
+    `[n]` int32 bitmasks: for each row p at its instant `now_rows[p]`, the
+    first `fq/wq/majority`-many ALIVE same-shard processes of p's
+    distance-sorted order (exactly `build_env`'s static construction with
+    crashed members skipped). When fewer members than a quorum size are
+    alive, the mask is short and acks can never reach the size — progress
+    stalls without a safety violation, the f-fault-tolerance contract."""
+    alive = alive_matrix(env, now_rows)  # [n, n] by global index
+    order = env.sorted_procs  # [n, n] static
+    ohp = dense.oh(order, n)  # [n, n, n] position -> member one-hot
+    in_shard = ((env.all_mask[:, None] >> order) & 1) == 1  # [n, n]
+    alive_of = jnp.any(ohp & alive[:, None, :], axis=2)  # [n, n]
+    elig = in_shard & alive_of
+    rank = jnp.cumsum(elig.astype(jnp.int32), axis=1) - elig
+
+    def mask_of(sizes):
+        # `sizes`: scalar, or [n] per-row quorum sizes
+        sel = elig & (rank < jnp.broadcast_to(sizes, (elig.shape[0],))[:, None])
+        return jnp.sum(
+            jnp.where(sel, jnp.int32(1) << order, 0), axis=1
+        ).astype(jnp.int32)
+
+    # majority size is not an Env scalar; recover it from the static mask
+    maj_size = dense.popcount(env.maj_mask)  # [n]
+    return mask_of(env.fq_size), mask_of(env.wq_size), mask_of(maj_size)
+
+
+def apply_dynamic_masks(env, n, now_rows):
+    """`env` with fq/wq/maj masks recomputed at each row's instant."""
+    fq, wq, maj = dynamic_masks(env, n, now_rows)
+    return env._replace(fq_mask=fq, wq_mask=wq, maj_mask=maj)
+
+
+def dynamic_masks_row(env, n, pid, now):
+    """`dynamic_masks` restricted to one process row — the quantum
+    runner's per-device form (each device only consumes its own masks, so
+    the full [n, n, n] one-hot recomputation would be waste inside its
+    handler loop). Identical math to the full version on row `pid`, which
+    is what keeps the two engines' quorum picks equal."""
+    t = jnp.asarray(now)
+    alive = ~((t >= env.crash_at) & (t < env.recover_at))  # [n]
+    order = dense.dget(env.sorted_procs, pid)  # [n]
+    in_shard = ((dense.dget(env.all_mask, pid) >> order) & 1) == 1
+    alive_of = jnp.any(dense.oh(order, n) & alive[None, :], axis=1)
+    elig = in_shard & alive_of
+    rank = jnp.cumsum(elig.astype(jnp.int32)) - elig
+
+    def mask_of(size):
+        sel = elig & (rank < size)
+        return jnp.sum(
+            jnp.where(sel, jnp.int32(1) << order, 0)
+        ).astype(jnp.int32)
+
+    maj_size = dense.popcount(dense.dget(env.maj_mask, pid))
+    return mask_of(env.fq_size), mask_of(env.wq_size), mask_of(maj_size)

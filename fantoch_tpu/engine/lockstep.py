@@ -60,6 +60,7 @@ import jax.numpy as jnp
 from ..core import workload as workload_mod
 from ..core import ids
 from ..ops import dense
+from . import faults as faults_mod
 from .types import (
     INF_TIME,
     KIND_PROTO_BASE,
@@ -138,6 +139,21 @@ class SimSpec:
     # prints when replicas disagree (fantoch_ps/src/protocol/mod.rs:787-871;
     # summary.explain_order_divergence renders it)
     order_log: bool = False
+    # deterministic fault injection (engine/faults.py): when True the engine
+    # reads the schedule from Env (crash/recover instants, partition window,
+    # drop/dup lotteries), loses scheduled messages at the pool-insert choke
+    # point, freezes crashed processes' periodic slots, defers deliveries
+    # into crash windows to the recovery instant, and recomputes quorum
+    # masks per instant to avoid crashed processes (perfect failure
+    # detection). False compiles the exact pre-fault programs — zero cost.
+    faults: bool = False
+    # static gate for the duplication lottery: it doubles the pool-insert
+    # candidate array at trace time, so crash/partition-only schedules
+    # (dup_pct == 0) must not pay for it
+    faults_dup: bool = False
+    # hard simulated-time stop (ms): bounds runs that a fault schedule
+    # stalls on purpose (> f crashes must stall, not spin to max_steps)
+    deadline_ms: Optional[int] = None
 
     @property
     def dots(self) -> int:
@@ -180,6 +196,16 @@ class Env(NamedTuple):
     conflict_rate: jnp.ndarray  # int32 percentage
     read_only_pct: jnp.ndarray  # int32 percentage
     seed: jnp.ndarray  # PRNG key data (uint32[2])
+    # fault schedule (engine/faults.py; read only when SimSpec.faults).
+    # Defaults of None keep pre-fault constructors valid — build_env always
+    # fills concrete no-fault arrays.
+    crash_at: Any = None  # [n] int32 crash instant (INF_TIME = never)
+    recover_at: Any = None  # [n] int32 recovery instant (INF_TIME = never)
+    part_a: Any = None  # int32 bitmask: partition group A (B = complement)
+    part_from: Any = None  # int32 partition window start
+    part_until: Any = None  # int32 partition window end (exclusive)
+    drop_pct: Any = None  # int32 hash-drop percentage (protocol messages)
+    dup_pct: Any = None  # int32 hash-duplication percentage
 
 
 class SimState(NamedTuple):
@@ -188,6 +214,10 @@ class SimState(NamedTuple):
     iters: jnp.ndarray  # body iterations (instants x sub-rounds; perf gauge)
     seqno: jnp.ndarray
     dropped: jnp.ndarray
+    # messages LOST to the fault schedule (crash arrivals, partition cuts,
+    # drop lottery) — intentional, counted apart from `dropped` (capacity
+    # loss, which must stay 0; summary.check_sim_health ignores `faulted`)
+    faulted: jnp.ndarray
     # conservative-lookahead bookkeeping (`_fast_round`; carried untouched by
     # the exact reorder-mode discipline)
     src_seq: jnp.ndarray  # [n+C] int32 per-source emission counters (tie keys)
@@ -214,6 +244,10 @@ class SimState(NamedTuple):
     c_resp: jnp.ndarray  # [C] int32 commands completed (open loop)
     c_sub_time: jnp.ndarray  # [C, CMDS] int32 per-command issue time (open loop)
     c_done: jnp.ndarray  # [C] bool
+    c_done_ms: jnp.ndarray  # [C, CT] int32 per-command completion instant
+    # (open loop: one slot per command; closed loop CT=1: last completion) —
+    # the raw material of the availability/recovery timelines
+    # (summary.availability_series / recovery_stats)
     c_got: jnp.ndarray  # [C, CT] int32 partial results per outstanding cmd
     # (closed loop: CT=1, one outstanding; open loop: CT=commands_per_client)
     c_vals: jnp.ndarray  # [C, CT, KPC] int32 per-key returned values of the
@@ -447,6 +481,8 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
     # native-oracle equality suites pin the "no observable change" claim.
     #
     # Default OFF (FOLD=1): measured on a v5e chip at the bench shapes,
+    # (and forced OFF under fault injection: fold prefixes would need the
+    # crash-deferral rules re-proved per fold step for no measured gain)
     # folding LOSES ~2x — under vmap the per-trip cost is dominated by the
     # handler/drain tensor updates, and lax.cond lowers to computing both
     # sides, so every trip pays all KF extra handler invocations whether or
@@ -455,7 +491,11 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
     # row-loop schedule the cond skips for real, so FANTOCH_FOLD>1 can pay
     # there; the batch axis, not per-config event grouping, is the TPU
     # throughput lever (bench.py).
-    FOLD = int(os.environ.get("FANTOCH_FOLD", "1")) if FAST else 1
+    FOLD = (
+        int(os.environ.get("FANTOCH_FOLD", "1"))
+        if FAST and not spec.faults
+        else 1
+    )
     KF = max(0, FOLD - 1)  # fold steps per trip beyond the first message
 
     # ------------------------------------------------------------------
@@ -463,6 +503,33 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
     # ------------------------------------------------------------------
 
     def _insert(st: SimState, env: Env, cand: Candidates) -> SimState:
+        if spec.faults:
+            # the single fault choke point: every message the simulation
+            # ever sends passes through here. Duplicate first (dup copies
+            # are ordinary candidates arriving 1 ms later, then subject to
+            # the same loss rules), then apply the schedule's losses. The
+            # duplication lottery doubles the candidate array, so it is
+            # gated by its own STATIC flag (SimSpec.faults_dup).
+            # lottery ids: seqno + per-VALID rank (the reorder_hash
+            # discipline below) — unique, consecutive across inserts;
+            # positional ids would collide between inserts since seqno
+            # only advances by the valid count
+            if spec.faults_dup:
+                ids0 = st.seqno + jnp.cumsum(cand.valid) - 1
+                dup_sel = (
+                    cand.valid
+                    & (cand.kind >= KIND_PROTO_BASE)
+                    & faults_mod.dup_lottery(env, ids0)
+                )
+                dup = cand._replace(valid=dup_sel, base=cand.base + 1)
+                cand = _cat_cands([cand, dup])
+            ids1 = st.seqno + jnp.cumsum(cand.valid) - 1
+            lost = cand.valid & faults_mod.candidate_drop_mask(
+                env, n, cand.kind, cand.src, cand.dst, cand.when,
+                cand.when + cand.base, ids1,
+            )
+            cand = cand._replace(valid=cand.valid & ~lost)
+            st = st._replace(faulted=st.faulted + lost.sum())
         CN = cand.valid.shape[0]
         base = cand.base
         if spec.reorder:
@@ -730,6 +797,17 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             all_mask=er.all_mask[None],
             closest_shard_proc=er.closest_shard_proc[None, :],
         )
+
+    def _handler_env(env: Env, now_rows: jnp.ndarray) -> Env:
+        """The Env view handlers see: under fault injection the quorum
+        masks are recomputed at each row's handling instant to avoid
+        crashed processes (faults.dynamic_masks — the perfect-failure-
+        detector quorum selection). Quorums already fixed inside in-flight
+        message payloads are untouched: a command whose quorum lost a
+        member stalls (safety over liveness)."""
+        if not spec.faults:
+            return env
+        return faults_mod.apply_dynamic_masks(env, n, now_rows)
 
     def _slice_env(env: Env, pid: int) -> Env:
         """Static per-process env view (leading axis kept at length 1)."""
@@ -1076,6 +1154,23 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
          lat_vals, lat_en, sub_valid, sub_base, sub_dst, sub_payload,
          tick_valid) = out
 
+        # per-command completion instants (the availability/recovery-latency
+        # raw data, summary.availability_series): open loop keys by the
+        # completed batch's rifl slots, closed loop records into slot 0
+        if OPEN:
+            first = jnp.clip(payload[:, 1] - 1, 0, CT - 1)  # [C]
+            done_slots = jnp.clip(
+                first[:, None] + jnp.arange(NR, dtype=jnp.int32)[None, :],
+                0,
+                CT - 1,
+            )  # [C, NR]
+        else:
+            done_slots = jnp.zeros((C, NR), jnp.int32)
+        done_hit = (dense.oh(done_slots, CT) & lat_en[:, :, None]).any(axis=1)
+        st = st._replace(
+            c_done_ms=jnp.where(done_hit, now_rows[:, None], st.c_done_ms)
+        )
+
         # latency histogram effects (dense scatter-add over [G, NB])
         bucket = jnp.clip(lat_vals, 0, NB - 1)  # [C, NR]
         oh_g = dense.oh(env.client_group, spec.n_client_groups)  # [C, G]
@@ -1136,10 +1231,26 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             return st.next_seq <= spec.max_seq
         return st.next_seq <= pdef.window_floor(st.proto) + spec.max_seq
 
-    def _eff_deliv(st: SimState) -> jnp.ndarray:
+    def _pool_times(env: Env, st: SimState) -> jnp.ndarray:
+        """[S] effective delivery times: the pool's arrival times, except
+        that a process-bound event landing inside its destination's crash
+        window waits for the recovery instant (insert-time loss already
+        removed arrivals IN the window; this covers events *deferred into*
+        it, e.g. window-blocked submits unblocking mid-crash)."""
+        if not spec.faults:
+            return st.m_time
+        is_procdst = (st.m_kind == KIND_SUBMIT) | (
+            st.m_kind >= KIND_PROTO_BASE
+        )
+        dstp = jnp.clip(st.m_dst, 0, n - 1)
+        deferred = faults_mod.crash_deferred_time(env, dstp, st.m_time)
+        return jnp.where(is_procdst, deferred, st.m_time)
+
+    def _eff_deliv(env: Env, st: SimState) -> jnp.ndarray:
         """[S] deliverable now — excluding submits whose coordinator's dot
-        window is full (they wait in the pool; GC frees slots over time)."""
-        deliv = st.m_valid & (st.m_time <= st.now)
+        window is full (they wait in the pool; GC frees slots over time)
+        and events deferred by a destination's crash window."""
+        deliv = st.m_valid & (_pool_times(env, st) <= st.now)
         if pdef.window_floor is None:
             return deliv
         can = _can_alloc(st)  # [n]
@@ -1150,7 +1261,7 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         return deliv & ~blocked_sub
 
     def _delivery_round(env: Env, wl_tabs, st: SimState) -> SimState:
-        deliv = _eff_deliv(st)  # [S]
+        deliv = _eff_deliv(env, st)  # [S]
         is_procmsg = (st.m_kind == KIND_SUBMIT) | (st.m_kind >= KIND_PROTO_BASE)
 
         def select(dest_mask):
@@ -1192,11 +1303,12 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
 
         # --- handlers (post-write command view) ---
         cmds = CmdView(st.cmd_client, st.cmd_rifl, st.cmd_keys, st.cmd_ro)
+        now_p = jnp.full((n,), st.now, jnp.int32)
         proto, exc, ob, res = _proc_rows(
-            st, env, cmds, has_p, kind_p, src_p, payload_p, gdot, ok
+            st, _handler_env(env, now_p), cmds, has_p, kind_p, src_p,
+            payload_p, gdot, ok,
         )
         st = st._replace(proto=proto, exec=exc)
-        now_p = jnp.full((n,), st.now, jnp.int32)
         st, replies = _route_results(st, env, res, now_p)
         st, subs, ticks = _client_rows(
             st, env, has_c, kind_c, payload_c,
@@ -1275,6 +1387,7 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         quiescent. One pass per firing instead of one per slot keeps the
         trip cost flat (under vmap all slot branches are computed either
         way; the per-pass row machinery is what collapses)."""
+        env = _handler_env(env, jnp.full((n,), st.now, jnp.int32))
         cmds = CmdView(st.cmd_client, st.cmd_rifl, st.cmd_keys, st.cmd_ro)
         due_mat = st.per_next <= st.now  # [n, NPER]
         k_star = jnp.argmax(due_mat.any(axis=0)).astype(jnp.int32)
@@ -1680,6 +1793,14 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         comp, ext, lk2c = aux
         INF = INF_TIME
         st = st._replace(iters=st.iters + 1)
+        if spec.faults:
+            # crashed processes' timers freeze: slots scheduled inside a
+            # crash window skip to recovery (idempotent normalization)
+            st = st._replace(
+                per_next=faults_mod.normalize_per_next(
+                    env, st.per_next, interval_arr
+                )
+            )
 
         # --- per-destination earliest pending event ---
         is_procmsg = (st.m_kind == KIND_SUBMIT) | (st.m_kind >= KIND_PROTO_BASE)
@@ -1704,6 +1825,14 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         # window-deferred submits deliver at the unblocking instant, never
         # in the past (lc = the destination's last-acted instant)
         msg_t = jnp.where(t1 < INF, jnp.maximum(t1, st.lc), INF)  # [D]
+        if spec.faults:
+            # deliveries deferred INTO a crash window wait for recovery
+            # (arrivals in the window were already lost at insert time)
+            tp = msg_t[:n]
+            in_win = (tp >= env.crash_at) & (tp < env.recover_at)
+            msg_t = msg_t.at[:n].set(
+                jnp.where(in_win, env.recover_at, tp)
+            )
         dp_t = jnp.where(st.drain_pend, st.lc[:n], INF)  # [n]
         evt_msg = msg_t.at[:n].min(dp_t)  # [D] message-phase event times
         if NT > 0:
@@ -1906,7 +2035,8 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         # --- merged row pass + effects ---
         cmds = CmdView(st.cmd_client, st.cmd_rifl, st.cmd_keys, st.cmd_ro)
         proto, exc, ob, res, dp_new, consumed, when_e = _proc_rows_fast(
-            st, env, cmds, has_p, kind_p, src_p, payload_p, gdot, ok,
+            st, _handler_env(env, now_p), cmds, has_p, kind_p, src_p,
+            payload_p, gdot, ok,
             act_tmr, kstar, act_dp, now_p,
             fk_valid, fk_kind, fk_src, fk_pay, fk_t,
         )
@@ -2026,6 +2156,7 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             iters=jnp.int32(0),
             seqno=jnp.int32(C),
             dropped=jnp.int32(0),
+            faulted=jnp.int32(0),
             src_seq=jnp.zeros((DTOT,), jnp.int32).at[n:].set(1),
             lc=jnp.zeros((DTOT,), jnp.int32),
             drain_pend=jnp.zeros((n,), jnp.bool_),
@@ -2060,6 +2191,7 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             c_resp=jnp.zeros((C,), jnp.int32),
             c_sub_time=jnp.zeros((C, CT), jnp.int32),
             c_done=jnp.zeros((C,), jnp.bool_),
+            c_done_ms=jnp.zeros((C, CT), jnp.int32),
             c_got=jnp.zeros((C, CT), jnp.int32),
             c_vals=jnp.zeros((C, CT, KPC), jnp.int32),
             b_cnt=jnp.zeros((C,), jnp.int32),
@@ -2104,14 +2236,28 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             st = st._replace(
                 m_time=st.m_time.at[:C].set(st.m_time[:C] * mult // 10)
             )
+        if spec.faults and not OPEN:
+            # the initial closed-loop submits bypass _insert: apply the
+            # same crash-arrival loss rule here (open-loop initial ticks
+            # are client-local — the client plane never faults)
+            lost0 = faults_mod.crashed_at(env, st.m_dst[:C], st.m_time[:C])
+            st = st._replace(
+                m_valid=st.m_valid.at[:C].set(st.m_valid[:C] & ~lost0),
+                faulted=st.faulted + lost0.sum(),
+            )
         return st
 
     def cond(st: SimState):
-        return (
+        ok = (
             ~(st.all_done & (st.now > st.final_time))
             & (st.step < spec.max_steps)
             & (st.now < INF_TIME)
         )
+        if spec.deadline_ms is not None:
+            # hard simulated-time stop: fault schedules with > f crashes
+            # stall BY DESIGN — bound them by sim time, not by step budget
+            ok = ok & (st.now <= spec.deadline_ms)
+        return ok
 
     def _end_instant(env: Env, st: SimState) -> SimState:
         """Nothing deliverable and no timer due at `now`: close the instant
@@ -2128,7 +2274,9 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             all_done=all_done,
         )
         times = jnp.where(
-            _eff_deliv(st._replace(now=INF_TIME)), st.m_time, INF_TIME
+            _eff_deliv(env, st._replace(now=INF_TIME)),
+            _pool_times(env, st),
+            INF_TIME,
         )
         return st._replace(now=jnp.minimum(times.min(), st.per_next.min()))
 
@@ -2148,7 +2296,13 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         cascades drain, then time advances.
         """
         st = st._replace(iters=st.iters + 1)
-        any_deliv = _eff_deliv(st).any()
+        if spec.faults:
+            st = st._replace(
+                per_next=faults_mod.normalize_per_next(
+                    env, st.per_next, interval_arr
+                )
+            )
+        any_deliv = _eff_deliv(env, st).any()
         any_due = (st.per_next <= st.now).any()
 
         def advance(st):

@@ -19,6 +19,7 @@ import numpy as np
 from ..core.config import Config
 from ..core.planet import Planet, closest_process_per_shard, process_ids, sort_processes_by_distance
 from ..core.workload import Workload
+from . import faults as faults_mod
 from .lockstep import Env, SimSpec
 from .types import ProtocolDef, mask_from_ids
 
@@ -43,6 +44,9 @@ def build_spec(
     open_loop_interval_ms: Optional[int] = None,
     batch_max_size: int = 1,
     batch_max_delay_ms: int = 0,
+    faults: bool = False,
+    faults_dup: bool = False,
+    deadline_ms: Optional[int] = None,
 ) -> SimSpec:
     if batch_max_size > 1:
         assert open_loop_interval_ms is not None, (
@@ -151,6 +155,9 @@ def build_spec(
         open_loop_interval_ms=open_loop_interval_ms,
         batch_max_size=batch_max_size,
         batch_max_delay_ms=batch_max_delay_ms,
+        faults=faults,
+        faults_dup=faults_dup,
+        deadline_ms=deadline_ms,
     )
 
 
@@ -179,8 +186,13 @@ def build_env(
     seed: int = 0,
     make_distances_symmetric: bool = False,
     link_delays: Optional[dict] = None,
+    faults: Optional["faults_mod.FaultSchedule"] = None,
 ) -> Env:
-    """`link_delays` injects artificial extra latency on process links — the
+    """`faults` attaches a deterministic fault schedule (engine/faults.py:
+    crash/recover instants, one partition window, drop/dup lotteries) to
+    this config's Env; build the spec with `faults=True` to activate it.
+
+    `link_delays` injects artificial extra latency on process links — the
     reference's per-address delay tasks (`fantoch/src/run/task/server/
     delay.rs:7-40`, enabled per connect address `run/mod.rs:104`): either
     `{global_process_index: extra_ms}` (all links of that process, the shape
@@ -282,7 +294,13 @@ def build_env(
         leader = id_to_idx[config.leader]
 
     kg = workload.key_gen
+    fault_fields = (
+        faults.env_fields(N)
+        if faults is not None
+        else faults_mod.no_fault_env_fields(N)
+    )
     return Env(
+        **fault_fields,
         shard_of=np.asarray(shard_of),
         closest_shard_proc=np.asarray(closest_shard_proc),
         dist_pp=np.asarray(dist_pp),

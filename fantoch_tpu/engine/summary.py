@@ -17,11 +17,15 @@ from .lockstep import Env, SimState
 from .types import ProtocolDef
 
 
-def check_sim_health(st: SimState) -> None:
+def check_sim_health(st: SimState, allow_stall: bool = False) -> None:
     """Raise if the run hit any capacity limit (results would be silently wrong).
 
     Works on single and vmapped-batch states alike (all checks are sums /
-    alls over however many leading axes there are).
+    alls over however many leading axes there are). `allow_stall` skips the
+    all-clients-finished check — fault schedules may stall clients BY
+    DESIGN (crashed connected processes, > f crashes); capacity losses
+    still abort (the schedule's own losses ride `SimState.faulted`, which
+    is intentional and not checked here).
     """
     dropped = int(np.asarray(st.dropped).sum())
     overflow = int(np.asarray(st.hist_overflow).sum())
@@ -37,7 +41,7 @@ def check_sim_health(st: SimState) -> None:
             total = int(np.asarray(leaf).sum())
             if total:
                 raise RuntimeError(f"capacity overflow in state leaf {path}: {total}")
-    if not bool(np.asarray(st.all_done).all()):
+    if not allow_stall and not bool(np.asarray(st.all_done).all()):
         raise RuntimeError("simulation ended before all clients finished")
 
 
@@ -127,6 +131,58 @@ def explain_order_divergence(st: SimState, workload, env: Env) -> str:
                 f"  p{p} [{at}:]: {seq[at:at + 6]}"
             )
     return "\n".join(lines)
+
+
+def availability_series(
+    st: SimState,
+    env: Env,
+    client_regions: Sequence[str],
+    bucket_ms: int = 100,
+) -> Dict[str, list]:
+    """region -> completions per `bucket_ms` of simulated time, from the
+    per-command completion instants (`SimState.c_done_ms`). The
+    throughput-timeline view of a fault run: a crash shows up as a dip, a
+    failover as the dip's recovery edge — the data rows
+    `plot.plots.recovery_plot` renders (site -> protocol -> series)."""
+    done = np.asarray(st.c_done_ms)  # [C, CT]
+    issued = np.asarray(st.c_issued)
+    group = np.asarray(env.client_group)
+    horizon = int(done.max()) if done.size else 0
+    nb = max(1, horizon // bucket_ms + 1)
+    out: Dict[str, list] = {}
+    for g, region in enumerate(client_regions):
+        counts = np.zeros((nb,), int)
+        for c in np.nonzero(group == g)[0]:
+            # slot i holds command i+1's completion; closed loops reuse
+            # slot 0, so only the latest completion is known there
+            times = done[c][done[c] > 0][: int(issued[c])]
+            for t in times:
+                counts[int(t) // bucket_ms] += 1
+        out[region] = counts.tolist()
+    return out
+
+
+def recovery_stats(st: SimState, env: Env) -> Dict[str, float]:
+    """Availability/recovery-latency numbers of one (possibly faulty) run:
+
+    - `completed`: commands with a recorded completion instant;
+    - `max_gap_ms`: the longest silence between consecutive completions
+      across all clients (a crash-to-failover window shows up here as
+      roughly detection timeout + recovery rounds);
+    - `last_completion_ms`: when the workload finished.
+
+    Closed-loop runs overwrite completion slots, so use open-loop clients
+    when the full timeline matters."""
+    done = np.asarray(st.c_done_ms).ravel()
+    times = np.sort(done[done > 0])
+    if not len(times):
+        return {"completed": 0, "max_gap_ms": 0.0, "last_completion_ms": 0.0}
+    gaps = np.diff(np.concatenate([[0], times]))
+    return {
+        "completed": int(len(times)),
+        "max_gap_ms": float(gaps.max()),
+        "last_completion_ms": float(times[-1]),
+    }
 
 
 def protocol_metrics(st: SimState, pdef: ProtocolDef) -> Dict[str, np.ndarray]:

@@ -8,7 +8,10 @@ slot order (`try_next_slot`, `slot.rs:89-96`). On device the unbounded
 contiguous prefix.
 
 Execution-info row layout (width 2): ``[slot, dot]`` — the command payload is
-read from the dense command table at execution time.
+read from the dense command table at execution time. A NEGATIVE dot marks a
+NOOP slot (FPaxos failover fills holes the crashed leader left with noops,
+protocols/fpaxos.py): the slot joins the contiguous order like any other but
+executes nothing and emits no result.
 """
 from __future__ import annotations
 
@@ -58,12 +61,13 @@ def make_executor(n: int, execute_at_commit: bool = False) -> ExecutorDef:
         KPC = ctx.spec.keys_per_command
         SLOTS = est.buf_dot.shape[1]
         slot, dot = info[0], info[1]
-        csl = ids.dot_slot(dot, ctx.spec.max_seq)
+        noop = dot < 0
+        csl = ids.dot_slot(jnp.maximum(dot, 0), ctx.spec.max_seq)
         if execute_at_commit:
             client = ctx.cmds.client[csl]
             rifl = ctx.cmds.rifl_seq[csl]
             kvs, ready = est.kvs, est.ready
-            wr = ~ctx.cmds.read_only[csl]
+            wr = ~ctx.cmds.read_only[csl] & ~noop
             for k in range(KPC):
                 key = ctx.cmds.keys[csl, k]
                 old = kvs[p, key]
@@ -71,8 +75,16 @@ def make_executor(n: int, execute_at_commit: bool = False) -> ExecutorDef:
                     jnp.where(wr, writer_id(client, rifl), old)
                 )
                 ready = ready_push(ready, p, client, rifl, kslot=k, value=old)
-            return est._replace(kvs=kvs, ready=ready)
-        est = est._replace(buf_dot=est.buf_dot.at[p, slot - 1].set(dot))
+            new = est._replace(kvs=kvs, ready=ready)
+            # a noop executes nothing and emits nothing
+            return jax.tree_util.tree_map(
+                lambda a, b: jnp.where(noop, b, a), new, est
+            )
+        # -2 buffers a noop marker (-1 stays "empty"): the slot joins the
+        # contiguous order but contributes no kv op and no result
+        est = est._replace(
+            buf_dot=est.buf_dot.at[p, slot - 1].set(jnp.where(noop, -2, dot))
+        )
 
         # try_next_slot (slot.rs:89-96): execute the whole contiguous
         # buffered prefix in one vectorized pass — slot order IS execution
@@ -82,15 +94,16 @@ def make_executor(n: int, execute_at_commit: bool = False) -> ExecutorDef:
         nxt = est.next_slot[p]  # 1-based
         j = jnp.arange(SLOTS, dtype=jnp.int32)
         pos = jnp.clip(nxt - 1 + j, 0, SLOTS - 1)
-        present = (est.buf_dot[p, pos] >= 0) & (nxt - 1 + j < SLOTS)
+        present = (est.buf_dot[p, pos] != -1) & (nxt - 1 + j < SLOTS)
         run = jnp.cumprod(present.astype(jnp.int32)).sum()  # prefix length
         # entries: run slots x key slots, slot-major
         E = SLOTS * KPC
         e_iota = jnp.arange(E, dtype=jnp.int32)
         r_of_e = e_iota // KPC
         k_of_e = e_iota % KPC
-        valid_e = r_of_e < run
         slot_e = jnp.clip(nxt - 1 + r_of_e, 0, SLOTS - 1)
+        noop_e = est.buf_dot[p, slot_e] == -2
+        valid_e = (r_of_e < run) & ~noop_e
         d_of_e = ids.dot_slot(
             jnp.maximum(est.buf_dot[p, slot_e], 0), ctx.spec.max_seq
         )

@@ -99,11 +99,52 @@ class Point:
     skip_fast_ack: bool = False
     execute_at_commit: bool = False
     caesar_wait_condition: bool = True
+    # deterministic fault injection (engine/faults.py): crash windows
+    # ((proc, at_ms, recover_ms; -1 = never), ...), one partition window
+    # ((procs...), from_ms, until_ms), hash drop/dup percentages, FPaxos
+    # leader_check interval, and a hard simulated-time stop for schedules
+    # that stall on purpose (all 0/() = fault-free, the pre-fault programs)
+    crash: Tuple[Tuple[int, int, int], ...] = ()
+    partition: Tuple = ()
+    drop_pct: int = 0
+    dup_pct: int = 0
+    leader_check_interval_ms: int = 0
+    deadline_ms: int = 0
+
+    def fault_schedule(self):
+        """The FaultSchedule of this point, or None when fault-free."""
+        from ..engine import faults as faults_mod
+
+        if not (self.crash or self.partition or self.drop_pct or self.dup_pct):
+            return None
+        crash = {
+            int(p): (int(t0), None if t1 < 0 else int(t1))
+            for p, t0, t1 in self.crash
+        }
+        partition = (
+            (tuple(self.partition[0]), self.partition[1], self.partition[2])
+            if self.partition
+            else None
+        )
+        return faults_mod.FaultSchedule(
+            crash=crash,
+            partition=partition,
+            drop_pct=self.drop_pct,
+            dup_pct=self.dup_pct,
+        )
 
     def search(self) -> Dict[str, Any]:
         d = dataclasses.asdict(self)
         d["clients"] = d.pop("clients_per_region")
         d["conflict"] = d.pop("conflict_rate")
+        # JSON-stable forms: the fault tuples round-trip through meta.json
+        # as lists, and ResultsDB.find / sweep resume compare equality
+        d["crash"] = [list(c) for c in self.crash]
+        d["partition"] = (
+            [list(self.partition[0]), self.partition[1], self.partition[2]]
+            if self.partition
+            else []
+        )
         return d
 
     def workload(self) -> Workload:
@@ -196,6 +237,12 @@ def _bucket_key(pt: Point) -> Tuple:
         pt.skip_fast_ack,
         pt.execute_at_commit,
         pt.caesar_wait_condition,
+        # fault-injection knobs that shape the SPEC (compile identity):
+        # the schedule itself is Env data and may vary within a bucket
+        pt.fault_schedule() is not None,
+        pt.dup_pct > 0,
+        pt.leader_check_interval_ms,
+        pt.deadline_ms,
     )
 
 
@@ -356,6 +403,9 @@ def run_grid(
         for pt in bpoints:
             config = Config(
                 n=n, f=pt.f, gc_interval_ms=gc_interval_ms, leader=leader,
+                leader_check_interval_ms=(
+                    pt.leader_check_interval_ms or None
+                ),
                 nfr=pt.nfr,
                 tempo_tiny_quorums=pt.tempo_tiny_quorums,
                 tempo_clock_bump_interval_ms=(
@@ -388,11 +438,15 @@ def run_grid(
                     # the per-event hot-op cost; drops abort via
                     # check_sim_health, so an undersized pool fails loudly)
                     pool_slots=pool_slots,
+                    faults=pt0.fault_schedule() is not None,
+                    faults_dup=pt0.dup_pct > 0,
+                    deadline_ms=pt0.deadline_ms or None,
                 )
             envs.append(
                 setup.build_env(
                     spec, config, planet, placement, pt.workload(), pdef,
                     seed=pt.seed,
+                    faults=pt.fault_schedule(),
                 )
             )
             searches.append(pt.search())
@@ -441,7 +495,11 @@ def run_grid(
         # sample after dropping mesh padding so events/sec counts only the
         # bucket's real configs
         dstat = _dstat_sample(wall_s, st)
-        summary.check_sim_health(st)
+        # fault schedules may stall clients by design (crashed connected
+        # processes, > f crashes); capacity checks still apply
+        summary.check_sim_health(
+            st, allow_stall=pt0.fault_schedule() is not None
+        )
 
         # executor metrics ride the same store, namespaced like the
         # reference's separate ExecutorMetrics (executor/mod.rs:123-130)

@@ -26,6 +26,8 @@ different chosen values.
 from __future__ import annotations
 
 import dataclasses
+import functools
+import itertools
 from typing import List, Optional, Tuple
 
 import jax
@@ -120,9 +122,12 @@ def _unpack(vec, n: int):
     return st, vec[9 * n], vec[9 * n + 1]
 
 
+@functools.lru_cache(maxsize=8)
 def _expand_fn(m: SynodModel):
     """One vmapped transition function: state vector -> [T, SW] successors
-    (invalid transitions return the unchanged state)."""
+    (invalid transitions return the unchanged state). Cached per model so
+    a crash-schedule sweep (enumerate_crash_schedules) shares one compiled
+    expansion — crashes restrict deliveries on the HOST side."""
     bits, deliveries = _message_space(m)
     n = m.n
     SW = _state_width(n)
@@ -212,9 +217,19 @@ def _initial_state(m: SynodModel):
 
 
 def check_agreement(
-    model: Optional[SynodModel] = None, max_levels: int = 64
+    model: Optional[SynodModel] = None,
+    max_levels: int = 64,
+    crashed: frozenset = frozenset(),
 ) -> dict:
-    """Exhaustive BFS; returns {states, levels, violation: bool}."""
+    """Exhaustive BFS; returns {states, levels, violation, decided}.
+
+    `crashed` names processes crashed FROM THE START: nothing is ever
+    delivered to them, hence they never reply — the per-process closure of
+    the monotone-network model's message loss (a crash at time t is
+    subsumed: every interleaving where the process's remaining deliveries
+    simply never happen is already in the restricted space). `decided`
+    reports whether any reachable state has a chosen value — the
+    availability side of the f-fault-tolerance contract."""
     m = model or SynodModel()
     _, _, expand = _expand_fn(m)
     n = m.n
@@ -224,13 +239,31 @@ def check_agreement(
         arr = np.ascontiguousarray(arr)
         return arr.view(f"V{arr.dtype.itemsize * SW}").ravel()
 
+    if crashed:
+        # a crashed receiver gets nothing: mask those deliveries out by
+        # running the expansion then discarding its transitions. The
+        # deliveries list is static, so filtering by receiver at the
+        # successor level (rows of `expand` are delivery-indexed) keeps
+        # the compiled expansion shared across schedules.
+        _, deliveries, _ = _expand_fn(m)
+        keep = np.asarray(
+            [d[4] not in crashed for d in deliveries], bool
+        )
+    else:
+        keep = None
+
     frontier = np.asarray(_initial_state(m), np.int32)[None, :]
     visited = rowkeys(frontier)
     total = 1
+    decided = False
     for level in range(max_levels):
         # chosen bitmask 3 = both values chosen somewhere on this path
         if (frontier[:, SW - 1] == 3).any():
-            return {"states": total, "levels": level, "violation": True}
+            return {
+                "states": total, "levels": level, "violation": True,
+                "decided": True,
+            }
+        decided = decided or bool((frontier[:, SW - 1] != 0).any())
         # pad the frontier to a power-of-two bucket (duplicate rows are
         # harmless — successors dedup) so each bucket compiles once
         F = len(frontier)
@@ -238,12 +271,39 @@ def check_agreement(
         padded = np.concatenate(
             [frontier, np.broadcast_to(frontier[:1], (bucket - F, SW))]
         )
-        succ = np.asarray(expand(jnp.asarray(padded)), np.int32)
+        succ = np.asarray(expand(jnp.asarray(padded)), np.int32)  # [F, T, SW]
+        if keep is not None:
+            succ = succ[:, keep, :]
         succ = np.unique(succ.reshape(-1, SW), axis=0)
         fresh = succ[~np.isin(rowkeys(succ), visited)]
         if not len(fresh):
-            return {"states": total, "levels": level, "violation": False}
+            return {
+                "states": total, "levels": level, "violation": False,
+                "decided": decided,
+            }
         visited = np.concatenate([visited, rowkeys(fresh)])
         total += len(fresh)
         frontier = fresh
     raise RuntimeError(f"state space not exhausted in {max_levels} levels")
+
+
+def enumerate_crash_schedules(
+    model: Optional[SynodModel] = None, max_crashes: Optional[int] = None
+) -> dict:
+    """Exhaustively check every crash schedule of up to `max_crashes`
+    processes (default f): for each subset, BFS the restricted state space
+    and record safety + decidability. The f-fault-tolerance contract in
+    checker form: NO schedule may violate agreement, and every schedule
+    with <= f crashes that leaves a proposer alive must still be able to
+    choose (a write quorum of f+1 survives by n >= 2f+1).
+
+    Returns {schedule (tuple) -> {states, levels, violation, decided}}."""
+    m = model or SynodModel()
+    max_crashes = m.f if max_crashes is None else max_crashes
+    out = {}
+    for k in range(max_crashes + 1):
+        for subset in itertools.combinations(range(m.n), k):
+            out[subset] = check_agreement(
+                m, crashed=frozenset(subset)
+            )
+    return out

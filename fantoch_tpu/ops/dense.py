@@ -25,6 +25,16 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
+def popcount(x) -> jnp.ndarray:
+    """Set-bit count of an int32 bitmask as int32 (traceable; the quorum
+    cardinality of the sender-masked ack sets used across protocols)."""
+    import jax
+
+    return jax.lax.population_count(
+        jnp.asarray(x).astype(jnp.uint32)
+    ).astype(jnp.int32)
+
+
 def oh(i, size: int) -> jnp.ndarray:
     """One-hot bool mask: lanes of `size` matching `i`.
 
@@ -185,7 +195,15 @@ def aset(x: jnp.ndarray, idx, v, where=None, op: str = "set") -> jnp.ndarray:
     if op == "add":
         return x + jnp.where(m, ev, jnp.zeros((), x.dtype))
     if op == "max":
-        return jnp.maximum(x, jnp.where(m, ev, jnp.iinfo(x.dtype).min))
+        # dtype-safe neutral element: jnp.iinfo raises on float dtypes and
+        # bool has no meaningful min — route each family explicitly
+        if x.dtype == jnp.bool_:
+            raise TypeError("aset(op='max') on bool array; use op='or'")
+        if jnp.issubdtype(x.dtype, jnp.inexact):
+            neutral = jnp.finfo(x.dtype).min
+        else:
+            neutral = jnp.iinfo(x.dtype).min
+        return jnp.maximum(x, jnp.where(m, ev, neutral))
     if op == "or":
         return x | (m & ev.astype(jnp.bool_))
     raise ValueError(op)

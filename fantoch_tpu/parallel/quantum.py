@@ -62,7 +62,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core import workload as workload_mod
 from ..core import ids
+from ..engine import faults as faults_mod
 from ..engine.lockstep import Env, SimSpec, message_width
+from ..ops import dense
 from ..engine.types import (
     INF_TIME,
     KIND_SUBMIT,
@@ -86,6 +88,25 @@ RK_TICK = 4
 RK_PROTO_BASE = 5
 
 AXIS = "procs"
+
+
+def _shard_map(fn, *, mesh, in_specs, out_specs):
+    """`jax.shard_map` across jax versions: the top-level API (with
+    `check_vma`) landed after 0.4.x; older runtimes ship it as
+    `jax.experimental.shard_map` (with `check_rep`). Replication checking
+    is disabled either way — the runner's scalar leaves are derived from
+    collectives and the checker cannot see that."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
 
 
 class LocalEnv(NamedTuple):
@@ -127,6 +148,7 @@ class RState(NamedTuple):
     step: jnp.ndarray  # [n] local handled-event counts
     send_seq: jnp.ndarray  # [n] per-source message counter (tie-break)
     dropped: jnp.ndarray  # [n] inbox/send overflow (must stay 0)
+    faulted: jnp.ndarray  # [n] messages lost to the fault schedule
     i_valid: jnp.ndarray  # [n, IP]
     i_time: jnp.ndarray
     i_src: jnp.ndarray
@@ -182,6 +204,18 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         "the distributed runner does not batch (client-side batching is an"
         " event-engine mode)"
     )
+    if spec.faults:
+        # crash + partition schedules are deterministic functions of TIME,
+        # so lockstep and the runner stay observation-equal under them; the
+        # drop/dup lotteries hash the ENGINE's message seqnos, which differ
+        # between the two engines by construction — event-engine only
+        assert int(np.asarray(env.drop_pct)) == 0 and int(
+            np.asarray(env.dup_pct)
+        ) == 0, (
+            "hash drop/dup lotteries are an event-engine mode (per-message"
+            " ids differ across engines); the runner supports crash and"
+            " partition schedules"
+        )
     OPEN = spec.open_loop_interval_ms is not None
     CT = spec.commands_per_client if OPEN else 1
     n, C_TOTAL, S = spec.n, spec.n_clients, spec.pool_slots
@@ -245,6 +279,17 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
 
     CM, cl_present, cl_gcid, cl_group, cl_conn, cl_dcp, g2p_np, g2s_np = client_layout()
 
+    # fault schedule (replicated device constants; engine/faults.py). The
+    # full Env rides along for the dynamic-quorum recomputation, which needs
+    # the global sorted orders/masks — identical inputs to the lockstep
+    # engine's `_handler_env`, so the two engines pick identical quorums.
+    F_CRASH = jnp.asarray(env.crash_at)  # [n]
+    F_REC = jnp.asarray(env.recover_at)  # [n]
+    F_PART_A = jnp.asarray(env.part_a)
+    F_PART_FROM = jnp.asarray(env.part_from)
+    F_PART_UNTIL = jnp.asarray(env.part_until)
+    genv = jax.tree_util.tree_map(jnp.asarray, env)
+
     lenv = LocalEnv(
         dist_pp=jnp.asarray(env.dist_pp),
         fq_mask=jnp.asarray(env.fq_mask),
@@ -292,6 +337,9 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         ro0 = np.asarray(ro0)
         client_proc = np.asarray(env.client_proc)
         dist_cp = np.asarray(env.dist_cp)
+        crash_np = np.asarray(env.crash_at)
+        rec_np = np.asarray(env.recover_at)
+        faulted0 = np.zeros((n,), np.int32)
         fill = [0] * n
         for c in range(C_TOTAL):
             if OPEN:
@@ -311,6 +359,11 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             # workload.rs:154-185)
             t = int(keys0[c, 0]) % SHARDS
             p = int(client_proc[c, t])
+            if spec.faults and crash_np[p] <= int(dist_cp[c, t]) < rec_np[p]:
+                # initial submit arrives inside the connected process's
+                # crash window: lost (matches the lockstep init_state rule)
+                faulted0[p] += 1
+                continue
             s = fill[p]
             fill[p] += 1
             iv[p, s] = True
@@ -328,6 +381,7 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             step=jnp.zeros((n,), jnp.int32),
             send_seq=jnp.asarray(fill, jnp.int32),
             dropped=jnp.zeros((n,), jnp.int32),
+            faulted=jnp.asarray(faulted0),
             i_valid=jnp.asarray(iv),
             i_time=jnp.asarray(it),
             i_src=jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None], (n, IP)),
@@ -371,12 +425,27 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             jnp.zeros((n,), jnp.int32),
         )
 
-    def local_env_view(myrow):
+    def local_env_view(myrow, now=None):
         """Env facade whose [p]-indexed arrays hold only our row (p=0).
 
         Handlers only read the quorum masks/sizes and scalars (see Env);
         the client-facing fields are runner-local shapes, unused by them.
+        Under fault injection (`now` given) the quorum masks are
+        recomputed at the handling instant to avoid crashed processes —
+        the same `faults.dynamic_masks` the lockstep engine applies, on
+        the same inputs, so both engines pick identical quorums.
         """
+        if spec.faults and now is not None:
+            dyn_fq, dyn_wq, dyn_maj = faults_mod.dynamic_masks_row(
+                genv, n, myrow, now
+            )
+            fq_row = dyn_fq[None]
+            wq_row = dyn_wq[None]
+            maj_row = dyn_maj[None]
+        else:
+            fq_row = lenv.fq_mask[myrow][None]
+            wq_row = lenv.wq_mask[myrow][None]
+            maj_row = lenv.maj_mask[myrow][None]
         return Env(
             dist_pp=lenv.dist_pp[myrow][None, :],
             dist_pc=lenv.dist_pc[myrow][None, :],
@@ -389,9 +458,9 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             closest_shard_proc=lenv.closest_shard_proc[myrow][None, :],
             client_group=lenv.cl_group[myrow],
             sorted_procs=lenv.sorted_procs[myrow][None, :],
-            fq_mask=lenv.fq_mask[myrow][None],
-            wq_mask=lenv.wq_mask[myrow][None],
-            maj_mask=lenv.maj_mask[myrow][None],
+            fq_mask=fq_row,
+            wq_mask=wq_row,
+            maj_mask=maj_row,
             all_mask=lenv.all_mask[myrow][None],
             f=lenv.f,
             fq_size=lenv.fq_size,
@@ -421,6 +490,22 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
 
     def send_push(L: Local, dst, time, kind, payload, enable) -> Local:
         """Append one row to the `dst` send column (traced dst)."""
+        if spec.faults:
+            # crash loss: submits arriving inside the destination process's
+            # window are lost (engine/faults.py contract; the client-plane
+            # kinds riding send_push — partials/replies/ticks — never fault)
+            lost = (
+                enable
+                & (kind == RK_SUBMIT)
+                & (time >= dense.dget(F_CRASH, dst))
+                & (time < dense.dget(F_REC, dst))
+            )
+            L = L._replace(
+                st=L.st._replace(
+                    faulted=L.st.faulted.at[0].add(lost.astype(jnp.int32))
+                )
+            )
+            enable = enable & ~lost
         slot = L.s_cnt[dst]
         ok = enable & (slot < SB)
         return L._replace(
@@ -460,14 +545,34 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         """
         dsts = jnp.arange(n, dtype=jnp.int32)
         en = enable & (bit(tgt_mask, dsts) == 1)  # [n]
-        slot = L.s_cnt
-        ok = en & (slot < SB)
-        tgt = jnp.where(ok, slot, SB)
         time = (
             jnp.broadcast_to(L.st.now, (n,))
             if zero_delay
             else L.st.now + lenv.dist_pp[myrow]
         )
+        if spec.faults:
+            # the engine's pool-insert loss rules at the send boundary:
+            # crash windows lose arriving process-plane traffic; the
+            # partition window cuts protocol links at emission time (RK_CMD
+            # command records are engine bookkeeping — the lockstep command
+            # table is global state — and never fault)
+            is_proc_kind = (kind == RK_SUBMIT) | (kind >= RK_PROTO_BASE)
+            crash_lost = is_proc_kind & (time >= F_CRASH) & (time < F_REC)
+            in_part = (L.st.now >= F_PART_FROM) & (L.st.now < F_PART_UNTIL)
+            across = (bit(F_PART_A, myrow) == 1) != (
+                bit(F_PART_A, dsts) == 1
+            )
+            part_lost = (kind >= RK_PROTO_BASE) & in_part & across
+            lost = en & (crash_lost | part_lost)
+            L = L._replace(
+                st=L.st._replace(
+                    faulted=L.st.faulted.at[0].add(lost.sum())
+                )
+            )
+            en = en & ~lost
+        slot = L.s_cnt
+        ok = en & (slot < SB)
+        tgt = jnp.where(ok, slot, SB)
         seq = L.st.send_seq[0]
         return L._replace(
             s_valid=L.s_valid.at[dsts, tgt].set(True, mode="drop"),
@@ -521,7 +626,7 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         return L
 
     def apply_execout(L: Local, myrow, execout) -> Local:
-        ctx = _ctx(L.st, local_env_view(myrow), myrow)
+        ctx = _ctx(L.st, local_env_view(myrow, L.st.now), myrow)
         estate = L.st.exec
         for i in range(pdef.max_exec):
             new_est = exdef.handle(ctx, estate, jnp.int32(0), execout.info[i], L.st.now)
@@ -584,7 +689,7 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
                 L, myrow, others, jnp.int32(RK_CMD), cmd_payload, ok,
                 zero_delay=True,
             )
-            ctx = _ctx(L.st, local_env_view(myrow), myrow)
+            ctx = _ctx(L.st, local_env_view(myrow, L.st.now), myrow)
             pst, outbox, execout = pdef.submit(
                 ctx, L.st.proto, jnp.int32(0), gdot, L.st.now
             )
@@ -748,7 +853,7 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             )
 
         def b_proto(L):
-            ctx = _ctx(L.st, local_env_view(myrow), myrow)
+            ctx = _ctx(L.st, local_env_view(myrow, L.st.now), myrow)
             pst, outbox, execout = pdef.handle(
                 ctx, L.st.proto, jnp.int32(0), src, kind - RK_PROTO_BASE,
                 payload, L.st.now,
@@ -860,7 +965,7 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
                 step=L.st.step.at[0].add(due.astype(jnp.int32)),
             )
         )
-        envv = local_env_view(myrow)
+        envv = local_env_view(myrow, L.st.now)
 
         def branch_proto(L, due, k):
             ctx = _ctx(L.st, envv, myrow)
@@ -920,6 +1025,21 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
 
     def quantum(L: Local, myrow) -> Local:
         st = L.st
+        if spec.faults:
+            # freeze crashed processes' periodic slots (shared rule with
+            # the lockstep engine: skip to the first multiple at/after
+            # recovery; idempotent per quantum)
+            import types as _pytypes
+
+            row_env = _pytypes.SimpleNamespace(
+                crash_at=dense.dget(F_CRASH, myrow)[None],
+                recover_at=dense.dget(F_REC, myrow)[None],
+            )
+            st = st._replace(
+                per_next=faults_mod.normalize_per_next(
+                    row_env, st.per_next, interval_arr
+                )
+            )
         t_inbox = jnp.where(st.i_valid[0], st.i_time[0], INF_TIME).min()
         t_local = jnp.minimum(t_inbox, st.per_next[0].min())
         now = jax.lax.pmin(t_local, AXIS)
@@ -961,6 +1081,10 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             & (max_step < spec.max_steps)
             & (t_next < INF_TIME)
         )
+        if spec.deadline_ms is not None:
+            # bound deliberately-stalled fault schedules by sim time (the
+            # engine's cond applies the same deadline)
+            cont = cont & (t_next <= spec.deadline_ms)
         return L._replace(st=st, cont=cont)
 
     def run_local(st_local):
@@ -993,12 +1117,11 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             lambda x: P(AXIS) if jnp.ndim(x) >= 1 else P(), state
         )
         fn = jax.jit(
-            jax.shard_map(
+            _shard_map(
                 run_local,
                 mesh=mesh,
                 in_specs=(specs,),
                 out_specs=specs,
-                check_vma=False,
             )
         )
         return fn(state)

@@ -115,6 +115,27 @@ def handle_accepted(sy: SynodState, p, dot, ballot, write_quorum_size, src):
 # ---------------------------------------------------------------------------
 
 
+def prepare_row(sy: SynodState, p, ballot, enable=True) -> SynodState:
+    """Multi-decree prepare: start a prepare round at `ballot` for EVERY
+    dot of row `p` at once — the MultiSynod recovery round's phase-1 reset
+    (one promise covers all slots, multi.rs's whole point). The scalar
+    `prepare` below is its single-decree form; `handle_promise` then runs
+    per dot as the per-slot accepted values stream in (FPaxos failover,
+    protocols/fpaxos.py)."""
+    enable = jnp.asarray(enable)
+
+    def setw(a, v):
+        return a.at[p, :].set(jnp.where(enable, v, a[p, :]))
+
+    return sy._replace(
+        prop_bal=setw(sy.prop_bal, ballot),
+        prop_acks=setw(sy.prop_acks, 0),
+        prom_mask=setw(sy.prom_mask, 0),
+        prom_abal=setw(sy.prom_abal, 0),
+        prom_aval=setw(sy.prom_aval, 0),
+    )
+
+
 def prepare(sy: SynodState, p, dot, ballot, enable=True) -> SynodState:
     """Proposer starts a prepare round at `ballot` (must exceed n so it can
     never collide with a skipped-prepare ballot; single.rs:87-92)."""

@@ -21,15 +21,52 @@ Reference parity: `fantoch_ps/src/protocol/fpaxos.rs` +
   *acceptor* state, so only write-quorum members count them — total Stable
   across processes is (f+1) x commands (`gc.rs:47-75`, `multi.rs:319-331`).
 
+Leader failover — the part the reference leaves as a TODO
+(`multi.rs` has no `proposal_gen`; `partial.rs:74-76`) — is implemented
+here and driven by the fault-injection subsystem (engine/faults.py):
+
+- every process tracks `cur_leader` and `last_heard` (any message from the
+  current leader is a heartbeat — its periodic `MGC` broadcast keeps the
+  link warm between commands); the `leader_check` periodic event (enabled
+  by `Config.leader_check_interval_ms`) raises suspicion after
+  `leader_timeout_ms` of silence;
+- the DESIGNATED CANDIDATE — the process after the leader in id order —
+  starts the MultiSynod recovery round at ballot `n + pid + 1` (> any
+  initial ballot, owner-recoverable as `(ballot - 1) % n`): one `MPrepare`
+  covers every slot (synod.prepare_row, the multi-decree phase-1);
+- acceptors promise (raising the shared `acc_ballot` register, which
+  fences the old leader's commanders) and then STREAM their accepted
+  per-slot values to the candidate, `recovery_k` slots per periodic fire
+  (`MPVal`; fixed-width messages cannot carry a whole accepted map);
+- the candidate folds each `MPVal` through the per-dot
+  `synod.handle_promise` — the prepare/promise quorum logic, sender-masked
+  against duplication — adopting, per slot, the highest-ballot accepted
+  value or a NOOP for holes; a promise quorum of `n - f` intersects every
+  f+1 write quorum, so no chosen slot can be missed;
+- once every slot is resolved, the candidate re-proposes slots
+  `own_stable+1 ..= hmax` through the ordinary commander/acceptor path
+  (noop slots carry dot -1; the slot executor skips them while advancing
+  its order frontier), then resumes fresh assignments from `hmax`;
+- forwarders re-forward pending (forwarded-but-uncommitted) commands to
+  the current leader on their own `leader_check` fires; `dot_slot_of`
+  dedups re-forwards at the leader, so lost forwards are retried and
+  duplicated ones assign no second slot.
+
+With `leader_check_interval_ms = None` (the default) none of this machinery
+runs and the protocol behaves exactly as before.
+
 Device layout: slots are dense 1-based indices into `[n, SLOTS]` tensors
-(acceptor / commander / commit-tracking state).
+(acceptor / commander / commit-tracking / recovery state).
 
 Message kinds/payloads (int32 rows):
 - MFORWARD  [dot]
-- MACCEPT   [ballot, slot, dot]
+- MACCEPT   [ballot, slot, dot]           (dot -1 = recovery noop)
 - MACCEPTED [ballot, slot]
 - MCHOSEN   [slot, dot]
 - MGC       [committed_frontier]
+- MPREPARE  [ballot]
+- MPROMISE  [ballot]
+- MPVAL     [ballot, slot, abal, aval]    (aval: 0 none, 1 noop, dot+2)
 """
 from __future__ import annotations
 
@@ -48,6 +85,8 @@ from ..engine.types import (
     outbox_row,
 )
 from ..executors import slot as slot_executor
+from ..ops import dense
+from .common import synod as sy
 from .common.mhist import distinct_count, hist_add, hist_init
 
 MFORWARD = 0
@@ -55,21 +94,38 @@ MACCEPT = 1
 MACCEPTED = 2
 MCHOSEN = 3
 MGC = 4
-N_KINDS = 5
+MPREPARE = 5
+MPROMISE = 6
+MPVAL = 7
+N_KINDS = 8
+
+# recovery phases (per-process scalar)
+REC_IDLE = 0
+REC_PREPARE = 1  # MPrepare out, collecting promises
+REC_ADOPT = 2  # promise quorum reached, folding streamed MPVals
+REC_DRIVE = 3  # all slots resolved, re-proposing own_stable+1..hmax
+REC_DONE = 4
+
+
+_popcount = dense.popcount
 
 
 class FPaxosState(NamedTuple):
     # leader (multi.rs:168-210)
     last_slot: jnp.ndarray  # [n] int32 last slot assigned (leader only)
+    cur_leader: jnp.ndarray  # [n] int32 believed current leader
+    last_heard: jnp.ndarray  # [n] int32 last instant heard from cur_leader
     # acceptor (multi.rs:262-338)
-    acc_ballot: jnp.ndarray  # [n] int32 promised ballot
+    acc_ballot: jnp.ndarray  # [n] int32 promised ballot (all slots)
     acc_has: jnp.ndarray  # [n, SLOTS] bool accepted entry exists
-    acc_dot: jnp.ndarray  # [n, SLOTS] int32 accepted value (dot)
-    # commanders (multi.rs:212-260)
+    acc_dot: jnp.ndarray  # [n, SLOTS] int32 accepted value (dot; -1 = noop)
+    acc_abal_slot: jnp.ndarray  # [n, SLOTS] int32 ballot of the accepted value
+    # commanders (multi.rs:212-260); acks are a sender BITMASK so duplicate
+    # deliveries cannot double-count (the synod `Accepts` process-id set)
     cmdr_alive: jnp.ndarray  # [n, SLOTS] bool
     cmdr_bal: jnp.ndarray  # [n, SLOTS] int32
     cmdr_dot: jnp.ndarray  # [n, SLOTS] int32
-    cmdr_acks: jnp.ndarray  # [n, SLOTS] int32
+    cmdr_acks: jnp.ndarray  # [n, SLOTS] int32 sender bitmask
     # commit tracking (synod/gc.rs)
     committed: jnp.ndarray  # [n, SLOTS] bool
     frontier: jnp.ndarray  # [n] int32 contiguous-committed frontier
@@ -80,31 +136,62 @@ class FPaxosState(NamedTuple):
     commit_count: jnp.ndarray  # [n] int32 MChosen handled
     key_count_hist: jnp.ndarray  # [n, KPC+2] CommandKeyCount at the leader
     # (fpaxos.rs:168-174)
+    # failover bookkeeping: dedup + retry of forwarded commands
+    dot_slot_of: jnp.ndarray  # [n, SLOTS] int32 slot of a dot (by dot slot)
+    pend_fwd: jnp.ndarray  # [n, SLOTS] bool forwarded/deferred, uncommitted
+    # recovery proposer (candidate) — per-slot adoption runs through the
+    # shared synod prepare/promise machinery (protocols/common/synod.py)
+    rec: sy.SynodState  # [n, SLOTS]
+    rec_ballot: jnp.ndarray  # [n] int32 recovery ballot (0 = none)
+    rec_phase: jnp.ndarray  # [n] int32 REC_*
+    rec_mask: jnp.ndarray  # [n] int32 promise-sender bitmask
+    rec_hmax: jnp.ndarray  # [n] int32 max slot any promiser accepted
+    rec_resolved: jnp.ndarray  # [n] int32 slots whose adoption completed
+    rec_next: jnp.ndarray  # [n] int32 accept-drive cursor (1-based slot)
+    # promise streaming (acceptor side): after promising, stream own
+    # accepted map to the candidate, recovery_k slots per periodic fire
+    pv_ballot: jnp.ndarray  # [n] int32 ballot being streamed for (0 = none)
+    pv_to: jnp.ndarray  # [n] int32 stream destination (the candidate)
+    pv_next: jnp.ndarray  # [n] int32 next slot to stream (1-based)
 
 
 def make_protocol(
-    n: int, keys_per_command: int = 1, execute_at_commit: bool = False
+    n: int,
+    keys_per_command: int = 1,
+    execute_at_commit: bool = False,
+    leader_timeout_ms: int = 200,
+    recovery_k: int = 2,
 ) -> ProtocolDef:
+    """`leader_timeout_ms`: silence from the current leader before the
+    designated candidate starts recovery (only reachable when
+    `Config.leader_check_interval_ms` enables the check). `recovery_k`:
+    slots advanced per periodic fire in the promise-streaming and
+    accept-drive phases (bounded by the fixed outbox width)."""
     KPC = keys_per_command
-    MSG_W = 3
-    MAX_OUT = 2
+    MSG_W = 4
+    K = recovery_k
+    MAX_OUT = max(2, K)
     MAX_EXEC = 1
     exdef = slot_executor.make_executor(n, execute_at_commit=execute_at_commit)
     EW = exdef.exec_width
 
     def init(spec, env):
         SLOTS = spec.dots
+        z = jnp.zeros((n, SLOTS), jnp.int32)
         return FPaxosState(
             last_slot=jnp.zeros((n,), jnp.int32),
+            cur_leader=jnp.full((n,), env.leader, jnp.int32),
+            last_heard=jnp.zeros((n,), jnp.int32),
             # acceptors bootstrap by joining the initial leader's ballot
             # (multi.rs:273-280); ballots are the 1-based leader id
             acc_ballot=jnp.full((n,), env.leader + 1, jnp.int32),
             acc_has=jnp.zeros((n, SLOTS), jnp.bool_),
-            acc_dot=jnp.zeros((n, SLOTS), jnp.int32),
+            acc_dot=z,
+            acc_abal_slot=z,
             cmdr_alive=jnp.zeros((n, SLOTS), jnp.bool_),
-            cmdr_bal=jnp.zeros((n, SLOTS), jnp.int32),
-            cmdr_dot=jnp.zeros((n, SLOTS), jnp.int32),
-            cmdr_acks=jnp.zeros((n, SLOTS), jnp.int32),
+            cmdr_bal=z,
+            cmdr_dot=z,
+            cmdr_acks=z,
             committed=jnp.zeros((n, SLOTS), jnp.bool_),
             frontier=jnp.zeros((n,), jnp.int32),
             peer_committed=jnp.zeros((n, n), jnp.int32),
@@ -113,20 +200,46 @@ def make_protocol(
             stable_count=jnp.zeros((n,), jnp.int32),
             commit_count=jnp.zeros((n,), jnp.int32),
             key_count_hist=hist_init(n, KPC + 2),
+            dot_slot_of=z,
+            pend_fwd=jnp.zeros((n, SLOTS), jnp.bool_),
+            rec=sy.synod_init(n, SLOTS),
+            rec_ballot=jnp.zeros((n,), jnp.int32),
+            rec_phase=jnp.zeros((n,), jnp.int32),
+            rec_mask=jnp.zeros((n,), jnp.int32),
+            rec_hmax=jnp.zeros((n,), jnp.int32),
+            rec_resolved=jnp.zeros((n,), jnp.int32),
+            rec_next=jnp.zeros((n,), jnp.int32),
+            pv_ballot=jnp.zeros((n,), jnp.int32),
+            pv_to=jnp.zeros((n,), jnp.int32),
+            pv_next=jnp.zeros((n,), jnp.int32),
         )
+
+    def _rec_busy(st: FPaxosState, p):
+        """Mid-recovery: fresh slot assignments must wait (a fresh slot
+        handed out before old assignments are resolved could collide with
+        a recovered slot)."""
+        return (st.rec_phase[p] >= REC_PREPARE) & (st.rec_phase[p] <= REC_DRIVE)
 
     def _leader_assign(ctx, st: FPaxosState, p, dot, enable):
         """Leader path: next slot + spawn commander + MAccept to the write
-        quorum (multi.rs:200-209,119-133). Returns (state, accept row)."""
+        quorum (multi.rs:200-209,119-133). Returns (state, accept row).
+        Dedups by dot (`dot_slot_of`): a re-forwarded command that already
+        holds a slot assigns nothing."""
+        dslot = ids.dot_slot(dot, ctx.spec.max_seq)
+        fresh = dense.aget(st.dot_slot_of, p, dslot) == 0
+        enable = enable & fresh
         slot = st.last_slot[p] + 1
         idx = slot - 1
-        b0 = ctx.env.leader + 1
+        # assignments after a failover run under the recovery ballot
+        b0 = jnp.where(
+            st.rec_ballot[p] > 0, st.rec_ballot[p], ctx.env.leader + 1
+        )
         st = st._replace(
             # the leader records command size when spawning the commander
             # (fpaxos.rs:168-174)
             key_count_hist=hist_add(
                 st.key_count_hist, p,
-                distinct_count(ctx.cmds.keys[ids.dot_slot(dot, ctx.spec.max_seq)]),
+                distinct_count(ctx.cmds.keys[dslot]),
                 enable,
             ),
             last_slot=st.last_slot.at[p].add(enable.astype(jnp.int32)),
@@ -142,21 +255,39 @@ def make_protocol(
             cmdr_acks=st.cmdr_acks.at[p, idx].set(
                 jnp.where(enable, 0, st.cmdr_acks[p, idx])
             ),
+            dot_slot_of=dense.aset(
+                st.dot_slot_of, (p, dslot), slot, where=enable
+            ),
         )
         return st, (enable, ctx.env.wq_mask[p], MACCEPT, [b0, slot, dot])
 
     def submit(ctx, st: FPaxosState, p, dot, now):
-        is_leader = ctx.pid == ctx.env.leader
-        st, accept = _leader_assign(ctx, st, p, dot, is_leader)
+        is_leader = ctx.pid == st.cur_leader[p]
+        assign = is_leader & ~_rec_busy(st, p)
+        st, accept = _leader_assign(ctx, st, p, dot, assign)
+        # anything not assigned right here is pending: forwarded commands
+        # await their MChosen, leader-deferred ones the end of recovery —
+        # both are retried by the leader_check periodic and cleared on
+        # MChosen (exactly-once via the dot dedup in _leader_assign)
+        dslot = ids.dot_slot(dot, ctx.spec.max_seq)
+        st = st._replace(
+            pend_fwd=dense.aset(
+                st.pend_fwd, (p, dslot), True, where=~assign, op="or"
+            )
+        )
         ob = empty_outbox(MAX_OUT, MSG_W)
-        # non-leader: forward to the leader (fpaxos.rs:182-193)
-        ob = outbox_row(ob, 0, ~is_leader, jnp.int32(1) << ctx.env.leader, MFORWARD, [dot])
+        # non-leader: forward to the CURRENT leader (fpaxos.rs:182-193)
+        ob = outbox_row(
+            ob, 0, ~is_leader, jnp.int32(1) << st.cur_leader[p], MFORWARD,
+            [dot],
+        )
         ob = outbox_row(ob, 1, *accept)
         return st, ob, empty_execout(MAX_EXEC, EW)
 
     def h_mforward(ctx, st: FPaxosState, p, src, payload, now):
         dot = payload[0]
-        st, accept = _leader_assign(ctx, st, p, dot, ctx.pid == ctx.env.leader)
+        enable = (ctx.pid == st.cur_leader[p]) & ~_rec_busy(st, p)
+        st, accept = _leader_assign(ctx, st, p, dot, enable)
         ob = outbox_row(empty_outbox(MAX_OUT, MSG_W), 0, *accept)
         return st, ob, empty_execout(MAX_EXEC, EW)
 
@@ -164,10 +295,21 @@ def make_protocol(
         ballot, slot, dot = payload[0], payload[1], payload[2]
         idx = slot - 1
         ok = ballot >= st.acc_ballot[p]  # multi.rs:306
+        # ballots encode their owner as (ballot - 1) % n (initial = 1-based
+        # leader id, recovery = n + candidate + 1): accepting one means
+        # accepting its leadership
         st = st._replace(
             acc_ballot=st.acc_ballot.at[p].max(jnp.where(ok, ballot, 0)),
             acc_has=st.acc_has.at[p, idx].set(st.acc_has[p, idx] | ok),
-            acc_dot=st.acc_dot.at[p, idx].set(jnp.where(ok, dot, st.acc_dot[p, idx])),
+            acc_dot=st.acc_dot.at[p, idx].set(
+                jnp.where(ok, dot, st.acc_dot[p, idx])
+            ),
+            acc_abal_slot=st.acc_abal_slot.at[p, idx].set(
+                jnp.where(ok, ballot, st.acc_abal_slot[p, idx])
+            ),
+            cur_leader=st.cur_leader.at[p].set(
+                jnp.where(ok, (ballot - 1) % n, st.cur_leader[p])
+            ),
         )
         ob = outbox_row(
             empty_outbox(MAX_OUT, MSG_W), 0, ok, jnp.int32(1) << src, MACCEPTED,
@@ -178,13 +320,17 @@ def make_protocol(
     def h_maccepted(ctx, st: FPaxosState, p, src, payload, now):
         ballot, slot = payload[0], payload[1]
         idx = slot - 1
-        # only accepts on the commander's ballot count (multi.rs:240-252)
+        # only accepts on the commander's ballot count, keyed by SENDER so
+        # re-delivery cannot double-count (multi.rs:240-252)
         match = st.cmdr_alive[p, idx] & (st.cmdr_bal[p, idx] == ballot)
-        acks = st.cmdr_acks[p, idx] + match.astype(jnp.int32)
-        chosen = match & (acks == ctx.env.wq_size)
+        new = match & (((st.cmdr_acks[p, idx] >> src) & 1) == 0)
+        acks = st.cmdr_acks[p, idx] | jnp.where(new, jnp.int32(1) << src, 0)
+        chosen = new & (_popcount(acks) == ctx.env.wq_size)
         st = st._replace(
             cmdr_acks=st.cmdr_acks.at[p, idx].set(acks),
-            cmdr_alive=st.cmdr_alive.at[p, idx].set(st.cmdr_alive[p, idx] & ~chosen),
+            cmdr_alive=st.cmdr_alive.at[p, idx].set(
+                st.cmdr_alive[p, idx] & ~chosen
+            ),
         )
         ob = outbox_row(
             empty_outbox(MAX_OUT, MSG_W), 0, chosen, ctx.env.all_mask[p], MCHOSEN,
@@ -202,13 +348,30 @@ def make_protocol(
             return (fr < SLOTS) & committed[p, jnp.clip(fr, 0, SLOTS - 1)]
 
         fr = jax.lax.while_loop(adv, lambda fr: fr + 1, st.frontier[p])
+        noop = dot < 0
+        # duplicate MCHOSEN deliveries exist by design (the dup lottery;
+        # failover re-proposing committed-but-unstable slots): only the
+        # FIRST commit of a slot counts and executes — without the guard
+        # the execute_at_commit path would re-run the write and emit a
+        # duplicate client reply
+        first = ~st.committed[p, idx]
+        dslot = ids.dot_slot(jnp.maximum(dot, 0), ctx.spec.max_seq)
         st = st._replace(
             committed=committed,
             frontier=st.frontier.at[p].set(fr),
-            commit_count=st.commit_count.at[p].add(1),
+            commit_count=st.commit_count.at[p].add(first.astype(jnp.int32)),
+            # the dot is decided: dedup future re-forwards, stop retrying
+            dot_slot_of=dense.aset(
+                st.dot_slot_of, (p, dslot), slot, where=~noop
+            ),
+            pend_fwd=dense.aset(
+                st.pend_fwd, (p, dslot), False, where=~noop
+            ),
         )
+        # noop slots (dot -1) flow to the slot executor, which skips their
+        # execution while advancing its order frontier through them
         execout = ExecOut(
-            valid=jnp.ones((MAX_EXEC,), jnp.bool_),
+            valid=jnp.broadcast_to(first, (MAX_EXEC,)),
             info=jnp.stack([slot, dot])[None, :],
         )
         return st, empty_outbox(MAX_OUT, MSG_W), execout
@@ -231,19 +394,129 @@ def make_protocol(
         gained = (st.acc_has[p] & in_range).sum().astype(jnp.int32)
         st = st._replace(
             acc_has=st.acc_has.at[p].set(st.acc_has[p] & ~in_range),
+            acc_abal_slot=st.acc_abal_slot.at[p].set(
+                jnp.where(in_range, 0, st.acc_abal_slot[p])
+            ),
             prev_stable=st.prev_stable.at[p].set(stable),
             stable_count=st.stable_count.at[p].add(gained),
+        )
+        return st, empty_outbox(MAX_OUT, MSG_W), empty_execout(MAX_EXEC, EW)
+
+    # ------------------------------------------------------------------
+    # failover round (MultiSynod prepare/promise; see module docstring)
+    # ------------------------------------------------------------------
+
+    def h_mprepare(ctx, st: FPaxosState, p, src, payload, now):
+        ballot = payload[0]
+        SLOTS = st.acc_has.shape[1]
+        # `>=` admits RE-prepares of the promised recovery ballot (ballots
+        # are owner-unique, so equality means the same candidate): the
+        # candidate re-broadcasts while unresolved, healing promise/stream
+        # messages a crash or partition window swallowed. Re-promising the
+        # same ballot is idempotent (sender-masked quorums).
+        ok = ballot >= st.acc_ballot[p]
+        # restart the value stream only when it is not already running for
+        # this ballot — a finished-but-insufficient stream re-sends (losses
+        # heal), a mid-flight one keeps its cursor (no restart livelock)
+        rearm = ok & (
+            (st.pv_ballot[p] != ballot) | (st.pv_next[p] > SLOTS)
+        )
+        st = st._replace(
+            acc_ballot=st.acc_ballot.at[p].max(jnp.where(ok, ballot, 0)),
+            cur_leader=st.cur_leader.at[p].set(
+                jnp.where(ok, (ballot - 1) % n, st.cur_leader[p])
+            ),
+            # arm the promise stream: our accepted map flows to the
+            # candidate K slots per leader_check fire
+            pv_ballot=st.pv_ballot.at[p].set(
+                jnp.where(ok, ballot, st.pv_ballot[p])
+            ),
+            pv_to=st.pv_to.at[p].set(jnp.where(ok, src, st.pv_to[p])),
+            pv_next=st.pv_next.at[p].set(
+                jnp.where(rearm, 1, st.pv_next[p])
+            ),
+        )
+        ob = outbox_row(
+            empty_outbox(MAX_OUT, MSG_W), 0, ok, jnp.int32(1) << src,
+            MPROMISE, [ballot],
+        )
+        return st, ob, empty_execout(MAX_EXEC, EW)
+
+    def h_mpromise(ctx, st: FPaxosState, p, src, payload, now):
+        ballot = payload[0]
+        active = (st.rec_phase[p] == REC_PREPARE) & (ballot == st.rec_ballot[p])
+        new = active & (((st.rec_mask[p] >> src) & 1) == 0)
+        mask = st.rec_mask[p] | jnp.where(new, jnp.int32(1) << src, 0)
+        # phase-1 quorum: n - f promisers intersect every f+1 write quorum
+        q1 = n - ctx.env.f
+        reach = new & (_popcount(mask) >= q1)
+        st = st._replace(
+            rec_mask=st.rec_mask.at[p].set(mask),
+            rec_phase=st.rec_phase.at[p].set(
+                jnp.where(reach, REC_ADOPT, st.rec_phase[p])
+            ),
+        )
+        return st, empty_outbox(MAX_OUT, MSG_W), empty_execout(MAX_EXEC, EW)
+
+    def h_mpval(ctx, st: FPaxosState, p, src, payload, now):
+        ballot, slot, abal, aval = (
+            payload[0], payload[1], payload[2], payload[3]
+        )
+        idx = slot - 1
+        active = (
+            ((st.rec_phase[p] == REC_PREPARE) | (st.rec_phase[p] == REC_ADOPT))
+            & (ballot == st.rec_ballot[p])
+        )
+        q1 = n - ctx.env.f
+        # the per-dot synod promise fold: adopt the highest-ballot reported
+        # value (or the noop initial 0) once q1 distinct senders reported
+        rec2, start, _val = sy.handle_promise(
+            st.rec, p, idx, ballot, abal, aval,
+            jnp.int32(0), q1, src,
+        )
+        rec2 = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(active, a, b), rec2, st.rec
+        )
+        start = start & active
+        resolved = st.rec_resolved[p] + start.astype(jnp.int32)
+        SLOTS = st.acc_has.shape[1]
+        all_resolved = (st.rec_phase[p] == REC_ADOPT) & (resolved >= SLOTS)
+        st = st._replace(
+            rec=rec2,
+            rec_resolved=st.rec_resolved.at[p].set(resolved),
+            rec_hmax=st.rec_hmax.at[p].max(
+                jnp.where(active & (aval > 0), slot, 0)
+            ),
+            rec_phase=st.rec_phase.at[p].set(
+                jnp.where(all_resolved, REC_DRIVE, st.rec_phase[p])
+            ),
+            # re-propose from our own stable watermark: everything at or
+            # below it is committed everywhere already
+            rec_next=st.rec_next.at[p].set(
+                jnp.where(all_resolved, st.prev_stable[p] + 1, st.rec_next[p])
+            ),
         )
         return st, empty_outbox(MAX_OUT, MSG_W), empty_execout(MAX_EXEC, EW)
 
     def handle(ctx, st, p, src, kind, payload, now):
         branches = [
             functools.partial(h, ctx)
-            for h in (h_mforward, h_maccept, h_maccepted, h_mchosen, h_mgc)
+            for h in (
+                h_mforward, h_maccept, h_maccepted, h_mchosen, h_mgc,
+                h_mprepare, h_mpromise, h_mpval,
+            )
         ]
-        return jax.lax.switch(kind, branches, st, p, src, payload, now)
+        st, ob, ex = jax.lax.switch(kind, branches, st, p, src, payload, now)
+        # any message from the current leader is a heartbeat
+        hb = src == st.cur_leader[p]
+        st = st._replace(
+            last_heard=st.last_heard.at[p].set(
+                jnp.where(hb, now, st.last_heard[p])
+            )
+        )
+        return st, ob, ex
 
-    def periodic(ctx, st: FPaxosState, p, kind, now):
+    def _periodic_gc(ctx, st: FPaxosState, p, now):
         # GarbageCollection: broadcast own committed frontier (fpaxos.rs:363-378)
         all_but_me = ctx.env.all_mask[p] & ~(jnp.int32(1) << ctx.pid)
         ob = outbox_row(
@@ -252,10 +525,176 @@ def make_protocol(
         )
         return st, ob
 
+    def _periodic_leader_check(ctx, st: FPaxosState, p, now):
+        """Failure detection + the recovery state machine's driver. One
+        role per fire (the outbox is K rows wide): start recovery, drive
+        re-proposals, stream promise values, or retry pending forwards."""
+        SLOTS = st.acc_has.shape[1]
+
+        suspect = (now - st.last_heard[p]) > leader_timeout_ms
+        is_cand = ctx.pid == (st.cur_leader[p] + 1) % n
+        start = (
+            is_cand & suspect
+            & (st.rec_phase[p] == REC_IDLE) & (st.rec_ballot[p] == 0)
+        )
+        # recovery ballot: the lowest round of our id-congruent ballot
+        # sequence (pid + 1 + k*n) that beats everything we promised —
+        # chained failovers keep ballots monotone even when the candidate
+        # ring wraps to a lower pid (a fixed k would be born fenced)
+        ballot = (
+            (st.acc_ballot[p] // jnp.int32(n) + 1) * jnp.int32(n)
+            + ctx.pid + 1
+        )
+        drive = ~start & (st.rec_phase[p] == REC_DRIVE)
+        stream = (
+            ~start & ~drive
+            & (st.pv_ballot[p] > 0) & (st.pv_next[p] <= SLOTS)
+        )
+        # unresolved recovery with nothing to stream locally: re-broadcast
+        # the prepare so promisers whose promise/stream a crash or
+        # partition window swallowed re-send (h_mprepare re-arms finished
+        # streams; mid-flight ones keep their cursor). Priority below the
+        # stream keeps the candidate's own self-stream progressing.
+        reprep = (
+            ~start & ~drive & ~stream
+            & ((st.rec_phase[p] == REC_PREPARE)
+               | (st.rec_phase[p] == REC_ADOPT))
+        )
+        retry = (
+            ~start & ~drive & ~stream & ~reprep & st.pend_fwd[p].any()
+        )
+        # the roles are mutually exclusive; each builds its own outbox and
+        # the winner is selected at the end (rows would clobber otherwise)
+        ob_start = empty_outbox(MAX_OUT, MSG_W)
+        ob_drive = empty_outbox(MAX_OUT, MSG_W)
+        ob_stream = empty_outbox(MAX_OUT, MSG_W)
+        ob_retry = empty_outbox(MAX_OUT, MSG_W)
+
+        # --- start: multi-decree prepare to everyone (including self) ---
+        st = st._replace(
+            rec=sy.prepare_row(st.rec, p, ballot, enable=start),
+            rec_ballot=st.rec_ballot.at[p].set(
+                jnp.where(start, ballot, st.rec_ballot[p])
+            ),
+            rec_phase=st.rec_phase.at[p].set(
+                jnp.where(start, REC_PREPARE, st.rec_phase[p])
+            ),
+        )
+        ob_start = outbox_row(
+            ob_start, 0, start | reprep, ctx.env.all_mask[p], MPREPARE,
+            [jnp.where(start, ballot, st.rec_ballot[p])],
+        )
+
+        # --- drive: re-propose K resolved slots via the commander path ---
+        drive_done = drive & (st.rec_next[p] > st.rec_hmax[p])
+        for k in range(K):
+            s = st.rec_next[p] + k
+            idx = jnp.clip(s - 1, 0, SLOTS - 1)
+            en = drive & (s <= st.rec_hmax[p])
+            v = dense.aget(st.rec.prop_val, p, idx)  # 0/1 noop, dot+2 real
+            wire = jnp.where(v >= 2, v - 2, jnp.int32(-1))
+            dslot = ids.dot_slot(jnp.maximum(wire, 0), ctx.spec.max_seq)
+            st = st._replace(
+                cmdr_alive=st.cmdr_alive.at[p, idx].set(
+                    jnp.where(en, True, st.cmdr_alive[p, idx])
+                ),
+                cmdr_bal=st.cmdr_bal.at[p, idx].set(
+                    jnp.where(en, st.rec_ballot[p], st.cmdr_bal[p, idx])
+                ),
+                cmdr_dot=st.cmdr_dot.at[p, idx].set(
+                    jnp.where(en, wire, st.cmdr_dot[p, idx])
+                ),
+                cmdr_acks=st.cmdr_acks.at[p, idx].set(
+                    jnp.where(en, 0, st.cmdr_acks[p, idx])
+                ),
+                dot_slot_of=dense.aset(
+                    st.dot_slot_of, (p, dslot), s, where=en & (wire >= 0)
+                ),
+            )
+            ob_drive = outbox_row(
+                ob_drive, k, en, ctx.env.wq_mask[p], MACCEPT,
+                [st.rec_ballot[p], s, wire],
+            )
+        st = st._replace(
+            rec_next=st.rec_next.at[p].add(jnp.where(drive, K, 0)),
+            rec_phase=st.rec_phase.at[p].set(
+                jnp.where(drive_done, REC_DONE, st.rec_phase[p])
+            ),
+            # fresh assignments resume past everything recovered OR already
+            # decided: hmax only covers slots whose accepts survived — a
+            # slot whose accepts were GC'd is stable, i.e. at or below the
+            # stable/committed watermarks, so the max of the three bounds
+            # every possibly-chosen slot
+            last_slot=st.last_slot.at[p].max(
+                jnp.where(
+                    drive_done,
+                    jnp.maximum(
+                        st.rec_hmax[p],
+                        jnp.maximum(st.prev_stable[p], st.frontier[p]),
+                    ),
+                    0,
+                )
+            ),
+        )
+
+        # --- stream: K slots of our accepted map to the candidate ---
+        for k in range(K):
+            s = st.pv_next[p] + k
+            idx = jnp.clip(s - 1, 0, SLOTS - 1)
+            en = stream & (s <= SLOTS)
+            has = dense.aget(st.acc_has, p, idx)
+            d = dense.aget(st.acc_dot, p, idx)
+            ab = dense.aget(st.acc_abal_slot, p, idx)
+            aval = jnp.where(
+                ~has.astype(jnp.bool_),
+                0,
+                jnp.where(d < 0, 1, d + 2),
+            )
+            ob_stream = outbox_row(
+                ob_stream, k, en, jnp.int32(1) << st.pv_to[p], MPVAL,
+                [st.pv_ballot[p], s, jnp.where(has, ab, 0), aval],
+            )
+        st = st._replace(
+            pv_next=st.pv_next.at[p].add(jnp.where(stream, K, 0))
+        )
+
+        # --- retry: re-forward K pending commands to the current leader
+        # (the dot dedup at the leader makes duplicates no-ops) ---
+        pend = st.pend_fwd[p]  # [SLOTS] by dot slot
+        rank = jnp.cumsum(pend.astype(jnp.int32)) - pend
+        W = ctx.spec.max_seq
+        slots_iota = jnp.arange(SLOTS, dtype=jnp.int32)
+        for k in range(K):
+            pick = pend & (rank == k)
+            en = retry & pick.any()
+            dsl = jnp.sum(jnp.where(pick, slots_iota, 0))
+            dot = ids.dot_make(dsl // W, dsl % W + 1)
+            ob_retry = outbox_row(
+                ob_retry, k, en, jnp.int32(1) << st.cur_leader[p], MFORWARD,
+                [dot],
+            )
+
+        def sel(flag, a, b):
+            return jax.tree_util.tree_map(
+                lambda x, y: jnp.where(flag, x, y), a, b
+            )
+
+        ob = sel(start | reprep, ob_start,
+                 sel(drive, ob_drive, sel(stream, ob_stream, ob_retry)))
+        return st, ob
+
+    def periodic(ctx, st: FPaxosState, p, kind, now):
+        # `kind` is static (spec.proto_periodic_kinds): 0 = GC broadcast,
+        # 1 = leader_check (only present when Config enables it)
+        if kind == 0:
+            return _periodic_gc(ctx, st, p, now)
+        return _periodic_leader_check(ctx, st, p, now)
+
     def metrics(st: FPaxosState):
         return {
             "stable": st.stable_count,
             "commits": st.commit_count,
+            "failovers": (st.rec_phase == REC_DONE).astype(jnp.int32),
             "command_key_count_hist": st.key_count_hist,
         }
 
@@ -269,7 +708,10 @@ def make_protocol(
         init=init,
         submit=submit,
         handle=handle,
-        periodic_events=(("garbage_collection", lambda cfg: cfg.gc_interval_ms),),
+        periodic_events=(
+            ("garbage_collection", lambda cfg: cfg.gc_interval_ms),
+            ("leader_check", lambda cfg: cfg.leader_check_interval_ms),
+        ),
         periodic=periodic,
         quorum_sizes=lambda cfg: (0, cfg.fpaxos_quorum_size(), 0),
         leaderless=False,

@@ -63,6 +63,69 @@ def test_dadd_many_accumulates_duplicates():
     assert dense.dadd_many(x, i, v).tolist() == [0, 5, 0, 4]
 
 
+def test_aget_matches_indexing():
+    x = jnp.arange(24, dtype=jnp.int32).reshape(2, 3, 4)
+    assert int(dense.aget(x, jnp.int32(1), jnp.int32(2), jnp.int32(3))) == int(
+        x[1, 2, 3]
+    )
+    # slice(None)/None keep their axis
+    assert dense.aget(x, jnp.int32(0), jnp.int32(1)).tolist() == x[0, 1].tolist()
+    assert dense.aget(
+        x, jnp.int32(1), slice(None), jnp.int32(0)
+    ).tolist() == x[1, :, 0].tolist()
+    # out of range reads 0 (NOT jnp's clamp semantics)
+    assert int(dense.aget(x, jnp.int32(7), jnp.int32(0), jnp.int32(0))) == 0
+    # bool arrays keep their dtype
+    b = jnp.zeros((2, 2), jnp.bool_).at[1, 0].set(True)
+    r = dense.aget(b, jnp.int32(1), jnp.int32(0))
+    assert bool(r) and r.dtype == jnp.bool_
+
+
+@pytest.mark.parametrize("op", ["set", "add", "max", "or"])
+def test_aset_matches_at_ops(op):
+    x = (jnp.arange(12, dtype=jnp.int32).reshape(3, 4) % 5) - 1
+    if op == "or":
+        x = x > 0
+        v = True
+        want = x.at[1, 2].set(x[1, 2] | v)
+    else:
+        v = jnp.int32(2)
+        want = getattr(x.at[1, 2], op)(v)
+    got = dense.aset(x, (jnp.int32(1), jnp.int32(2)), v, op=op)
+    assert got.tolist() == want.tolist()
+    # where=False gates the whole write
+    same = dense.aset(
+        x, (jnp.int32(1), jnp.int32(2)), v, where=jnp.bool_(False), op=op
+    )
+    assert same.tolist() == x.tolist()
+    # out-of-range indices write nothing
+    oob = dense.aset(x, (jnp.int32(9), jnp.int32(2)), v, op=op)
+    assert oob.tolist() == x.tolist()
+
+
+@pytest.mark.parametrize("op", ["set", "add", "max"])
+def test_aset_slice_rows(op):
+    x = jnp.arange(12, dtype=jnp.int32).reshape(3, 4) - 6
+    v = jnp.full((4,), 2, jnp.int32)
+    want = getattr(x.at[2], op)(v)
+    got = dense.aset(x, (jnp.int32(2), slice(None)), v, op=op)
+    assert got.tolist() == want.tolist()
+
+
+def test_aset_max_float_dtype_safe():
+    # jnp.iinfo raises on floats: op="max" must route through finfo —
+    # including NEGATIVE values, where a wrong neutral element would leak
+    x = jnp.asarray([[-5.0, -7.0], [-1.0, -2.0]], jnp.float32)
+    got = dense.aset(x, (jnp.int32(0), jnp.int32(1)), jnp.float32(-6.0), op="max")
+    assert got.tolist() == x.at[0, 1].max(-6.0).tolist()
+
+
+def test_aset_max_bool_rejected():
+    b = jnp.zeros((2, 2), jnp.bool_)
+    with pytest.raises(TypeError):
+        dense.aset(b, (jnp.int32(0), jnp.int32(0)), True, op="max")
+
+
 def test_dset_many_distinct():
     x = jnp.full((4, 2), -1, jnp.int32)
     i = jnp.asarray([0, 2, 9], jnp.int32)

@@ -1,0 +1,429 @@
+"""Deterministic fault injection: crash / partition / drop schedules with
+exercised recovery paths (engine/faults.py; ISSUE 1 acceptance suite).
+
+Scenario design notes:
+
+- Each protocol's config places the crash VICTIM outside every surviving
+  coordinator's quorums (far region + quorum sizes), so `<= f` crashes
+  leave the fast/write quorums intact — the f-fault-tolerance contract.
+  Quorum masks ride inside message payloads, and under `spec.faults` the
+  engine additionally recomputes them per instant (perfect failure
+  detection), so post-crash submits avoid dead members either way.
+- `conflict_rate=100` forces the slow paths of the leaderless protocols,
+  so commits while `f` replicas are down exercise MConsensus/retry rounds,
+  not just the fast path.
+- Clients are placed only on surviving processes: a client whose connected
+  process crashes is not a "surviving client" (its commands cannot
+  commit; the reference has no client retransmission either).
+"""
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.planet import Planet
+from fantoch_tpu.core.workload import KeyGen, Workload
+from fantoch_tpu.engine import lockstep, setup, summary
+from fantoch_tpu.engine.faults import FaultSchedule
+from fantoch_tpu.engine.types import INF_TIME
+
+CLIENT_REGIONS = ["us-west1", "us-west2"]
+
+# per-protocol shapes: victim sits in a region far from every other
+# process, so distance-sorted quorums of the given sizes never include it
+CONFIGS = {
+    # n=3, f=1: fq/wq/maj of size 2 = the two close US processes
+    "basic": dict(n=3, f=1, victim=2, cmds=6,
+                  regions=["us-west1", "us-west2", "europe-west2"]),
+    "tempo": dict(n=3, f=1, victim=2, cmds=6,
+                  regions=["us-west1", "us-west2", "europe-west2"]),
+    "atlas": dict(n=3, f=1, victim=2, cmds=6,
+                  regions=["us-west1", "us-west2", "europe-west2"]),
+    "epaxos": dict(n=3, f=1, victim=2, cmds=6,
+                   regions=["us-west1", "us-west2", "europe-west2"]),
+    # leader = reference id 1 = process 0; victim is a follower outside
+    # the leader's f+1 write quorum (failover has its own test below)
+    "fpaxos": dict(n=3, f=1, victim=2, cmds=6, leader=1,
+                   regions=["us-west1", "us-west2", "europe-west2"]),
+    # caesar's fast quorum is 3n/4+1 = 4 of 5: exactly the four clustered
+    # US processes once australia is down
+    "caesar": dict(n=5, f=1, victim=4, cmds=3,
+                   regions=["us-west1", "us-west2", "us-central1",
+                            "us-east1", "australia-southeast1"]),
+}
+
+
+def make_pdef(name, n, total_cmds, leader_timeout_ms=150):
+    from fantoch_tpu.protocols import (atlas, basic, caesar, epaxos, fpaxos,
+                                       tempo)
+
+    if name == "caesar":
+        return caesar.make_protocol(n, 1, max_seq=total_cmds)
+    if name == "fpaxos":
+        return fpaxos.make_protocol(n, 1, leader_timeout_ms=leader_timeout_ms)
+    return {"basic": basic, "tempo": tempo, "atlas": atlas,
+            "epaxos": epaxos}[name].make_protocol(n, 1)
+
+
+def build(name, cfg, sched, *, conflict=100, order_log=False,
+          deadline_ms=60_000, open_loop=None, leader_check=None, cmds=None):
+    planet = Planet.new()
+    n = cfg["n"]
+    cmds = cmds if cmds is not None else cfg["cmds"]
+    config = Config(
+        n=n, f=cfg["f"], gc_interval_ms=20, leader=cfg.get("leader"),
+        leader_check_interval_ms=leader_check,
+    )
+    wl = Workload(1, KeyGen.conflict_pool(conflict, 2), 1, cmds)
+    pdef = make_pdef(name, n, len(CLIENT_REGIONS) * cmds)
+    spec = setup.build_spec(
+        config, wl, pdef, n_clients=len(CLIENT_REGIONS), n_client_groups=2,
+        extra_ms=1000, max_steps=5_000_000, faults=True,
+        faults_dup=bool(sched is not None and sched.dup_pct),
+        deadline_ms=deadline_ms, order_log=order_log,
+        open_loop_interval_ms=open_loop,
+    )
+    placement = setup.Placement(cfg["regions"], CLIENT_REGIONS, 1)
+    env = setup.build_env(spec, config, planet, placement, wl, pdef,
+                          faults=sched)
+    return spec, pdef, wl, env
+
+
+def run(spec, pdef, wl, env):
+    st = jax.jit(lockstep.make_run(spec, pdef, wl))(env)
+    return jax.tree_util.tree_map(np.asarray, st)
+
+
+# ---------------------------------------------------------------------------
+# (a) <= f crashes after warm-up: surviving clients commit, execution
+#     orders match the fault-free run
+# ---------------------------------------------------------------------------
+
+
+# default tier keeps one cheap protocol per executor family (basic: slot
+# replication; atlas: dependency graph); the other four run the identical
+# assertions at other shapes in the heavy tier (conftest tiering policy —
+# the default suite already exceeds the CI wall budget)
+@pytest.mark.parametrize(
+    "name",
+    [
+        pytest.param("atlas"),
+        pytest.param("basic"),
+        pytest.param("caesar", marks=pytest.mark.heavy),
+        pytest.param("epaxos", marks=pytest.mark.heavy),
+        pytest.param("fpaxos", marks=pytest.mark.heavy),
+        pytest.param("tempo", marks=pytest.mark.heavy),
+    ],
+)
+def test_crash_f_survivors_commit_and_agree(name):
+    cfg = CONFIGS[name]
+    sched = FaultSchedule(crash={cfg["victim"]: (100, None)})
+    spec, pdef, wl, env = build(name, cfg, sched)
+    st = run(spec, pdef, wl, env)
+
+    assert int(st.dropped) == 0, "capacity loss is a bug even under faults"
+    assert int(st.faulted) > 0, "the schedule must actually lose messages"
+    assert bool(st.all_done), "every surviving client command must commit"
+
+    # fault-free reference restricted to the same commands (identical
+    # client set and seeds -> identical workload)
+    spec0, pdef0, wl0, env0 = build(name, cfg, None)
+    st0 = run(spec0, pdef0, wl0, env0)
+    assert bool(st0.all_done) and int(st0.faulted) == 0
+
+    survivors = [p for p in range(cfg["n"]) if p != cfg["victim"]]
+    # returned values (CommandResult contents) must agree exactly
+    np.testing.assert_array_equal(st.c_vals, st0.c_vals)
+    # client-observed latencies agree: the victim was in nobody's quorum,
+    # so its silence must not change any surviving commit decision
+    np.testing.assert_array_equal(st.lat_sum, st0.lat_sum)
+    np.testing.assert_array_equal(st.lat_cnt, st0.lat_cnt)
+    # per-key execution-order hashes on surviving replicas match
+    oh = getattr(st.exec, "order_hash", None)
+    if oh is not None:
+        np.testing.assert_array_equal(
+            oh[survivors], st0.exec.order_hash[survivors]
+        )
+
+
+# ---------------------------------------------------------------------------
+# (b) > f crashes: the run stalls with NO safety violation
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.heavy
+def test_more_than_f_crashes_stall_without_divergence():
+    cfg = dict(n=4, f=1, victim=None, cmds=6,
+               regions=["us-west1", "us-west2", "us-central1",
+                        "europe-west2"])
+    # two crashes with f=1: tempo's fast quorum (3) cannot form among the
+    # 2 survivors — progress must stop, safety must not
+    sched = FaultSchedule(crash={2: (80, None), 3: (80, None)})
+    spec, pdef, wl, env = build("tempo", cfg, sched, order_log=True,
+                                deadline_ms=10_000)
+    st = run(spec, pdef, wl, env)
+
+    assert not bool(st.all_done), "> f crashes must stall the workload"
+    assert int(st.dropped) == 0
+    # executed prefixes agree across the surviving replicas: for every
+    # key, one survivor's execution sequence is a prefix of the other's
+    orders = summary.execution_orders(st, wl, env)
+    for key, per_proc in orders.items():
+        a, b = per_proc[0], per_proc[1]
+        short = min(len(a), len(b))
+        assert a[:short] == b[:short], (
+            f"survivors diverge on key {key}: {a} vs {b}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# (c) FPaxos leader failover via the synod prepare/promise recovery round
+# ---------------------------------------------------------------------------
+
+
+def test_fpaxos_leader_failover_resumes_committing():
+    from fantoch_tpu.protocols import fpaxos
+
+    cfg = dict(n=3, f=1, victim=0, cmds=6, leader=1,
+               regions=["europe-west2", "us-west1", "us-west2"])
+    sched = FaultSchedule(crash={0: (250, None)})
+    spec, pdef, wl, env = build(
+        "fpaxos", cfg, sched, leader_check=10, deadline_ms=120_000,
+    )
+    st = run(spec, pdef, wl, env)
+
+    assert int(st.dropped) == 0
+    assert bool(st.all_done), "clients must complete after the failover"
+    # the designated candidate (leader+1) ran the recovery round to DONE
+    assert int(st.proto.rec_phase[1]) == fpaxos.REC_DONE
+    assert int(st.proto.cur_leader[1]) == 1 and int(st.proto.cur_leader[2]) == 1
+    # the failovers metric surfaces it
+    assert int(pdef.metrics(st.proto)["failovers"].sum()) == 1
+    # commits resumed: survivors decided every command (possibly plus
+    # healing/noop re-proposals; the dead leader stopped early)
+    total = spec.n_clients * spec.commands_per_client
+    assert int(st.proto.frontier[1]) >= total
+    assert int(st.proto.commit_count[0]) < int(st.proto.commit_count[1])
+
+
+def test_fpaxos_failover_availability_surfacing(tmp_path):
+    """Open-loop failover run -> recovery stats + the plot/ recovery
+    family (the availability/recovery-latency numbers of the ISSUE)."""
+    cfg = dict(n=3, f=1, victim=0, cmds=8, leader=1,
+               regions=["europe-west2", "us-west1", "us-west2"])
+    sched = FaultSchedule(crash={0: (250, None)})
+    spec, pdef, wl, env = build(
+        "fpaxos", cfg, sched, leader_check=10, deadline_ms=120_000,
+        open_loop=40,
+    )
+    st = run(spec, pdef, wl, env)
+    assert bool(st.all_done)
+
+    stats = summary.recovery_stats(st, env)
+    assert stats["completed"] == spec.n_clients * spec.commands_per_client
+    # the outage window shows up as the longest completion gap: at least
+    # the detection timeout, well below the run bound
+    assert stats["max_gap_ms"] >= 150
+    assert stats["max_gap_ms"] < 5_000
+
+    series = summary.availability_series(st, env, CLIENT_REGIONS,
+                                         bucket_ms=100)
+    assert set(series) == set(CLIENT_REGIONS)
+    assert sum(sum(v) for v in series.values()) == stats["completed"]
+
+    from fantoch_tpu.plot import plots
+
+    out = plots.recovery_plot(
+        {region: {"fpaxos": series[region]} for region in series},
+        str(tmp_path / "recovery.png"),
+    )
+    assert os.path.exists(out)
+
+
+# ---------------------------------------------------------------------------
+# (d) determinism + engine equality under a crash schedule
+# ---------------------------------------------------------------------------
+
+
+def test_fault_schedule_bit_identical_reruns():
+    cfg = CONFIGS["basic"]
+    sched = FaultSchedule(
+        crash={cfg["victim"]: (100, None)},
+        partition=([0], 40, 60),
+        drop_pct=3,
+        dup_pct=3,
+    )
+    spec, pdef, wl, env = build("basic", cfg, sched, deadline_ms=8_000)
+    run_fn = jax.jit(lockstep.make_run(spec, pdef, wl))
+    a = jax.tree_util.tree_map(np.asarray, run_fn(env))
+    b = jax.tree_util.tree_map(np.asarray, run_fn(env))
+    flat_a, _ = jax.tree_util.tree_flatten(a)
+    flat_b, _ = jax.tree_util.tree_flatten(b)
+    for i, (x, y) in enumerate(zip(flat_a, flat_b)):
+        np.testing.assert_array_equal(x, y, err_msg=f"leaf {i}")
+
+
+def test_crash_recover_window_heals():
+    """A crash WITH recovery: the victim freezes for the window (timers
+    skip to recovery, arrivals are lost) and the run still completes."""
+    cfg = CONFIGS["basic"]
+    sched = FaultSchedule(crash={cfg["victim"]: (50, 400)})
+    spec, pdef, wl, env = build("basic", cfg, sched)
+    st = run(spec, pdef, wl, env)
+    assert bool(st.all_done) and int(st.dropped) == 0
+    assert int(st.faulted) > 0
+
+
+def test_partition_window_heals():
+    """Cutting the victim off for a window loses traffic across the cut
+    but never stalls quorums that avoid it."""
+    cfg = CONFIGS["basic"]
+    sched = FaultSchedule(partition=([cfg["victim"]], 30, 200))
+    spec, pdef, wl, env = build("basic", cfg, sched)
+    st = run(spec, pdef, wl, env)
+    assert bool(st.all_done) and int(st.dropped) == 0
+    assert int(st.faulted) > 0
+
+
+def test_duplication_is_harmless_for_sender_masked_quorums():
+    """30% duplication: FPaxos quorums are sender bitmasks (like the synod
+    ones the model checker exercises), so duplicates cannot double-count
+    and the run completes with the same commit decisions."""
+    cfg = CONFIGS["fpaxos"]
+    sched = FaultSchedule(dup_pct=30)
+    spec, pdef, wl, env = build("fpaxos", cfg, sched)
+    st = run(spec, pdef, wl, env)
+    assert bool(st.all_done) and int(st.dropped) == 0
+    spec0, pdef0, wl0, env0 = build("fpaxos", cfg, None)
+    st0 = run(spec0, pdef0, wl0, env0)
+    np.testing.assert_array_equal(st.c_vals, st0.c_vals)
+    np.testing.assert_array_equal(
+        st.proto.frontier, st0.proto.frontier
+    )
+
+
+@pytest.mark.heavy
+def test_quantum_runner_matches_lockstep_under_crash():
+    """Acceptance (d): the distributed runner and the lockstep engine stay
+    observation-equal under the same crash schedule (shared rules from
+    engine/faults.py at both engines' insert/deliver boundaries)."""
+    from fantoch_tpu.parallel import quantum
+    from fantoch_tpu.protocols import basic as basic_proto
+
+    n = 8
+    regions = ["asia-east1", "us-central1", "us-west1", "europe-west2",
+               "europe-west3", "us-east1", "asia-southeast1",
+               "australia-southeast1"]
+    planet = Planet.new()
+    config = Config(n=n, f=1, gc_interval_ms=100)
+    wl = Workload(1, KeyGen.conflict_pool(100, 1), 1, 6)
+    pdef = basic_proto.make_protocol(n, 1)
+    spec = setup.build_spec(
+        config, wl, pdef, n_clients=2, n_client_groups=2, extra_ms=1000,
+        max_steps=5_000_000, faults=True, deadline_ms=60_000,
+    )
+    placement = setup.Placement(regions, ["us-west1", "europe-west2"], 1)
+    # victim: australia, far from both client regions' quorums
+    sched = FaultSchedule(crash={7: (60, None)})
+    env = setup.build_env(spec, config, planet, placement, wl, pdef,
+                          faults=sched)
+
+    st = run(spec, pdef, wl, env)
+    assert bool(st.all_done) and int(st.dropped) == 0
+
+    runner = quantum.build_runner(spec, pdef, wl, env)
+    mesh = quantum.make_mesh(n)
+    rst = runner.run_sharded(mesh, runner.init_state())
+    rst = jax.tree_util.tree_map(np.asarray, rst)
+    assert int(rst.dropped.sum()) == 0 and bool(rst.all_done)
+
+    np.testing.assert_array_equal(rst.hist.sum(axis=0), st.hist)
+    np.testing.assert_array_equal(
+        np.asarray(rst.proto.commit_count), np.asarray(st.proto.commit_count)
+    )
+    assert int(rst.faulted.sum()) == int(st.faulted)
+
+
+# ---------------------------------------------------------------------------
+# model checker: crash-schedule sweep (safety under every <= f subset)
+# ---------------------------------------------------------------------------
+
+
+def test_mc_crash_schedules_safe_and_live():
+    from fantoch_tpu.mc.checker import SynodModel, check_agreement
+
+    m = SynodModel()
+    for p in range(m.n):
+        r = check_agreement(m, crashed=frozenset([p]))
+        assert not r["violation"], f"crash {{{p}}} violated agreement"
+        # <= f crashes leave a write quorum + a proposer: still decidable
+        assert r["decided"], f"crash {{{p}}} lost availability"
+    # > f crashes may lose availability but never safety
+    r = check_agreement(m, crashed=frozenset([0, 2]))
+    assert not r["violation"]
+
+
+@pytest.mark.heavy
+def test_mc_crash_schedule_enumeration_heavy():
+    from fantoch_tpu.mc.checker import SynodModel, enumerate_crash_schedules
+
+    res = enumerate_crash_schedules(SynodModel())
+    for sched, r in res.items():
+        assert not r["violation"], sched
+        assert r["decided"], sched
+
+
+# ---------------------------------------------------------------------------
+# pure-helper units (cheap anchors for the shared fault rules)
+# ---------------------------------------------------------------------------
+
+
+def test_normalize_per_next_freezes_and_skips():
+    import jax.numpy as jnp
+    from types import SimpleNamespace
+
+    from fantoch_tpu.engine.faults import normalize_per_next
+
+    env = SimpleNamespace(
+        crash_at=jnp.asarray([100, int(INF_TIME)], jnp.int32),
+        recover_at=jnp.asarray([250, int(INF_TIME)], jnp.int32),
+    )
+    per_next = jnp.asarray([[120, 90], [120, 90]], jnp.int32)
+    iv = jnp.asarray([50, 40], jnp.int32)
+    out = np.asarray(normalize_per_next(env, per_next, iv))
+    # crashed row: 120 -> first 120 + k*50 >= 250 = 270; 90 fires pre-crash
+    assert out[0].tolist() == [270, 90]
+    # healthy row unchanged
+    assert out[1].tolist() == [120, 90]
+    # permanent crash pushes timers to INF (engine stops on INF clocks)
+    env2 = SimpleNamespace(
+        crash_at=jnp.asarray([100], jnp.int32),
+        recover_at=jnp.asarray([int(INF_TIME)], jnp.int32),
+    )
+    out2 = np.asarray(
+        normalize_per_next(env2, jnp.asarray([[120]], jnp.int32),
+                           jnp.asarray([50], jnp.int32))
+    )
+    assert out2[0, 0] >= int(INF_TIME)
+
+
+def test_dynamic_masks_avoid_crashed_members():
+    from fantoch_tpu.engine.faults import dynamic_masks
+
+    cfg = CONFIGS["basic"]
+    spec, pdef, wl, env = build(
+        "basic", cfg, FaultSchedule(crash={cfg["victim"]: (100, None)})
+    )
+    import jax.numpy as jnp
+
+    env_j = jax.tree_util.tree_map(jnp.asarray, env)
+    before = dynamic_masks(env_j, cfg["n"], jnp.full((3,), 50, jnp.int32))
+    after = dynamic_masks(env_j, cfg["n"], jnp.full((3,), 150, jnp.int32))
+    vbit = 1 << cfg["victim"]
+    # pre-crash masks match the static construction
+    np.testing.assert_array_equal(np.asarray(before[0]), np.asarray(env.fq_mask))
+    # post-crash masks never include the victim
+    for mask in after:
+        assert not (np.asarray(mask) & vbit).any()

@@ -205,7 +205,9 @@ def test_quantum_runner_matches_event_engine_caesar_colocated():
     from fantoch_tpu.protocols import caesar as caesar_proto
 
     st, rst = _run_both_engines(
-        caesar_proto.make_protocol(8, 1, max_seq=16),
+        # max_seq must equal the spec's derived dot window (Caesar sizes
+        # its dep bitmaps by it at trace time): 2 clients x 5 commands
+        caesar_proto.make_protocol(8, 1, max_seq=10),
         Config(n=8, f=1, gc_interval_ms=100),
         # four processes in us-west1 (with both client regions' closest
         # processes among them), four in europe-west2

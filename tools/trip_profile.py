@@ -64,18 +64,34 @@ def measure(name, batches, trips=400):
         it1 = int(np.asarray(st2.iters).max())
         ev = int(np.asarray(st2.step).sum() - np.asarray(st1.step).sum())
         ntrips = it1 - it0
+        # the timed chunk can execute far fewer trips than requested (the
+        # sim may finish inside the warm-up chunk): flooring ntrips to 1
+        # would emit a meaningless ms/trip — mark the point unreliable and
+        # keep it out of the fixed/marginal fit instead
+        reliable = ntrips >= max(1, trips // 10)
         out[B] = {
             "trips": ntrips,
             "events": ev,
             "wall_s": round(dt, 4),
-            "ms_per_trip": round(dt / max(ntrips, 1) * 1e3, 3),
-            "events_per_config_per_trip": round(ev / max(ntrips, 1) / B, 3),
+            "ms_per_trip": (
+                round(dt / ntrips * 1e3, 3) if ntrips > 0 else None
+            ),
+            "events_per_config_per_trip": (
+                round(ev / ntrips / B, 3) if ntrips > 0 else None
+            ),
             "events_per_sec": round(ev / dt, 1),
             "hlo_lines": hlo_ops,
             "flops_per_call": flops,
         }
+        if not reliable:
+            out[B]["unreliable"] = True
+            print(
+                f"WARNING: {name} B={B} executed {ntrips} trips of the"
+                f" {trips} requested — excluded from the fixed/marginal fit",
+                file=sys.stderr, flush=True,
+            )
         print(f"{name} B={B}: {out[B]}", file=sys.stderr, flush=True)
-    bs = sorted(out)
+    bs = sorted(b for b in out if not out[b].get("unreliable"))
     if len(bs) >= 2:
         b0, b1 = bs[0], bs[-1]
         m0, m1 = out[b0]["ms_per_trip"], out[b1]["ms_per_trip"]
