@@ -2354,6 +2354,44 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             lambda s: cond(s) & (s.step < limit), fn, st,
         )
 
+    def done_flag(st: SimState) -> jnp.ndarray:
+        """Device-side termination predicate (scalar bool) — exactly the
+        negation of the while-loop `cond`, including the deadline stop."""
+        return ~cond(st)
+
+    def run_megachunk(
+        env: Env, st: SimState, chunk_steps: int, k: int
+    ) -> Tuple[SimState, jnp.ndarray]:
+        """Run up to `k` sequential `run_chunk` segments in ONE device call.
+
+        Bit-identical to `k` host-driven `run_chunk(env, st, chunk_steps)`
+        calls — each segment recomputes its own step limit from the state at
+        segment entry (a segment's final trip may overshoot the limit, so a
+        single flat `k * chunk_steps` bound would stop at different trips) —
+        but the host syncs on the returned int8 `done` scalar instead of
+        materializing the full SimState between segments. The chunk-length
+        bound per *segment* is preserved, so per-program runtime still has
+        the same watchdog-friendly ceiling scaled by `k`.
+        """
+        fn = _body_for(env)
+
+        def segment(s: SimState) -> SimState:
+            limit = s.step + chunk_steps
+            return jax.lax.while_loop(
+                lambda x: cond(x) & (x.step < limit), fn, s
+            )
+
+        def outer_cond(carry):
+            s, i = carry
+            return (i < k) & cond(s)
+
+        def outer_body(carry):
+            s, i = carry
+            return segment(s), i + 1
+
+        st, _ = jax.lax.while_loop(outer_cond, outer_body, (st, jnp.int32(0)))
+        return st, done_flag(st).astype(jnp.int8)
+
     class Engine:
         pass
 
@@ -2362,6 +2400,8 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
     eng.init_state = init_state
     eng.run = run
     eng.run_chunk = run_chunk
+    eng.run_megachunk = run_megachunk
+    eng.done_flag = done_flag
     return eng
 
 

@@ -55,7 +55,11 @@ def shard_envs(batched_env: Env, mesh: Optional[jax.sharding.Mesh] = None) -> En
 
 
 def make_chunked_runner(
-    spec: SimSpec, pdef: ProtocolDef, wl: Workload, chunk_steps: int = 50_000
+    spec: SimSpec,
+    pdef: ProtocolDef,
+    wl: Workload,
+    chunk_steps: int = 50_000,
+    donate: bool = True,
 ):
     """Build `(init, chunk, done)` for segment-wise batched execution.
 
@@ -63,24 +67,70 @@ def make_chunked_runner(
     advancing every config by at most `chunk_steps` events (finished configs
     early-exit), `done(state) -> bool` (host). Bounded per-call device
     runtime; iterate until done.
+
+    `donate=True` (default) donates the state argument to XLA so the
+    [B, n, DOTS] SoA updates in place instead of copying per call. Donation
+    deletes the *input* state after each call: callers that keep a reference
+    to a pre-chunk state across the call — e.g. to `save_state` the same
+    snapshot after advancing past it — must pass `donate=False`.
     """
     from .lockstep import make_engine
 
     eng = make_engine(spec, pdef, wl)
     init = jax.jit(jax.vmap(eng.init_state))
     chunk = jax.jit(
-        jax.vmap(lambda env, st: eng.run_chunk(env, st, chunk_steps))
+        jax.vmap(lambda env, st: eng.run_chunk(env, st, chunk_steps)),
+        donate_argnums=(1,) if donate else (),
     )
 
+    done_fn = jax.jit(jax.vmap(eng.done_flag))
+
     def done(st: SimState) -> bool:
-        finished = np.asarray(
-            (st.all_done & (st.now > st.final_time))
-            | (st.step >= spec.max_steps)
-            | (st.now >= int(INF_TIME))
-        )
-        return bool(finished.all())
+        return bool(np.asarray(done_fn(st)).all())
 
     return init, chunk, done
+
+
+def make_megachunk_runner(
+    spec: SimSpec,
+    pdef: ProtocolDef,
+    wl: Workload,
+    chunk_steps: int = 50_000,
+    # k=4 matches the bench's BENCH_MEGA_K default: callers size
+    # chunk_steps so ONE chunk stays under the tunneled TPU's ~40 s stall
+    # watchdog, and a megachunk multiplies single-call runtime by up to k
+    k: int = 4,
+    donate: bool = True,
+):
+    """Build `(init, mega)` for device-resident megachunk execution.
+
+    `mega(batched_env, state) -> (state, done)` advances every config
+    through up to `k` sequential `chunk_steps`-bounded segments inside ONE
+    device call, evaluating the done predicate on device between segments
+    (engine `run_megachunk`). `done` is a scalar int8 (1 iff every config
+    finished) — the only value the host needs to pull per dispatch, so the
+    per-megachunk host round-trip shrinks from the full batched SimState to
+    one byte and host syncs drop from O(chunks) to O(chunks / k).
+
+    Bit-identical to driving `make_chunked_runner`'s `chunk` in a host loop
+    with the same `chunk_steps` (pinned by tests/test_megachunk.py). With
+    `donate=True` the state argument is donated so XLA updates it in place;
+    checkpointing callers that re-read a pre-call state must use the
+    non-donating chunked runner instead.
+    """
+    from .lockstep import make_engine
+
+    eng = make_engine(spec, pdef, wl)
+    init = jax.jit(jax.vmap(eng.init_state))
+
+    def _mega(env: Env, st: SimState):
+        st, done = jax.vmap(
+            lambda e, s: eng.run_megachunk(e, s, chunk_steps, k)
+        )(env, st)
+        return st, done.min()
+
+    mega = jax.jit(_mega, donate_argnums=(1,) if donate else ())
+    return init, mega
 
 
 def summarize_batch(st: SimState) -> dict:
@@ -128,5 +178,10 @@ def load_state(path: str, like):
             f"checkpoint leaf {i} is {x.dtype}{x.shape}, state needs "
             f"{ref.dtype}{ref.shape} — wrong spec/batch for this checkpoint"
         )
-        loaded.append(jnp.asarray(x))
+        # .copy(): a device-OWNED buffer. `jnp.asarray` may alias the numpy
+        # memory zero-copy on the CPU backend, and feeding such a borrowed
+        # buffer to a donating runner (make_chunked_runner/megachunk
+        # default) lets XLA update memory numpy still owns — observed as
+        # state corruption/SIGABRT in the checkpoint-resume test.
+        loaded.append(jnp.asarray(x).copy())
     return jax.tree_util.tree_unflatten(treedef, loaded)

@@ -471,15 +471,34 @@ def run_grid(
         )
         t0 = time.perf_counter()
         with trace_ctx:
-            if chunk_steps:
+            if chunk_steps and metrics_log:
+                # per-chunk host snapshots are the metrics_log feature, so
+                # this path keeps the host-driven chunk loop (state donated
+                # in place; the snapshot reads the post-chunk state)
                 init, chunk, done = sweep.make_chunked_runner(
                     spec, pdef, wl, chunk_steps
                 )
                 st = init(batched)
                 while not done(st):
                     st = chunk(batched, st)
-                    if metrics_log:
-                        _append_metrics_snapshot(metrics_log, bi, st, pdef)
+                    _append_metrics_snapshot(metrics_log, bi, st, pdef)
+                    if verbose:
+                        print(
+                            f"bucket {bi}: steps "
+                            f"{np.asarray(st.step).sum()}", flush=True
+                        )
+            elif chunk_steps:
+                # device-resident megachunk driver (the bench's): several
+                # chunks per device call, donated state, one int8 host sync
+                # per megachunk instead of a full-state pull per chunk
+                init, mega = sweep.make_megachunk_runner(
+                    spec, pdef, wl, chunk_steps
+                )
+                st = init(batched)
+                finished = 0
+                while not finished:
+                    st, d = mega(batched, st)
+                    finished = int(d)
                     if verbose:
                         print(
                             f"bucket {bi}: steps "

@@ -52,3 +52,52 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "heavy" in item.keywords:
             item.add_marker(skip)
+
+
+# ---------------------------------------------------------------------------
+# session-scoped compiled-engine cache (round-4 task #6): the lockstep
+# engine's `run` is one sizeable XLA program per (protocol, shape-bucket);
+# tests that drive the same (protocol, SimSpec) — across files, e.g.
+# test_quantum_runner.py's engine sides and test_partial_replication.py —
+# share ONE traced+jitted callable per session instead of recompiling per
+# test. The persistent on-disk cache only skips XLA compilation; this also
+# skips re-tracing/lowering the 2k-line engine, which dominates on this
+# 1-core host.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="session")
+def engine_runs():
+    """`get(spec, pdef, wl, extra=()) -> jitted run(env)` with session
+    caching.
+
+    Keyed by (pdef.name, spec, repr(wl), engine-relevant env overrides):
+    SimSpec is a frozen dataclass and hashable, the workload's constants
+    (key pool, zipf cdf) are baked into the compiled program
+    (WorkloadConsts.build) so the workload is part of the identity
+    (dataclass repr covers every field deterministically), and the engine
+    reads FANTOCH_EXACT / FANTOCH_ROW_LOOP / FANTOCH_FOLD /
+    FANTOCH_TPU_OPS at build time. Protocol-FACTORY flags (nfr,
+    skip_fast_ack, ...) change the program without changing name or spec —
+    callers using non-default factory flags must thread them through
+    `extra` to keep the key sound."""
+    from fantoch_tpu.engine import lockstep
+
+    cache = {}
+
+    def get(spec, pdef, wl, extra=()):
+        key = (
+            pdef.name,
+            spec,
+            repr(wl),
+            tuple(extra),
+            os.environ.get("FANTOCH_EXACT", ""),
+            os.environ.get("FANTOCH_ROW_LOOP", ""),
+            os.environ.get("FANTOCH_FOLD", ""),
+            os.environ.get("FANTOCH_TPU_OPS", ""),
+        )
+        if key not in cache:
+            cache[key] = jax.jit(lockstep.make_run(spec, pdef, wl))
+        return cache[key]
+
+    return get

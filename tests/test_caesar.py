@@ -51,6 +51,11 @@ def run(
     spec = setup.build_spec(
         config, workload, pdef, n_clients=C, n_client_groups=len(CLIENT_REGIONS),
         max_seq=max_seq, extra_ms=2000, max_steps=5_000_000, reorder=reorder,
+        # the reorder mode multiplies network delays by x[0,10): tail
+        # latencies legitimately exceed the default 2048 x 1ms histogram
+        # (seen as a 1-latency overflow -> check_sim_health failure), so
+        # give the reordered run the headroom the multiplier implies
+        hist_buckets=16384 if reorder else 2048,
     )
     placement = setup.Placement(PROCESS_REGIONS[:n], CLIENT_REGIONS, clients_per_region)
     env = setup.build_env(spec, config, planet, placement, workload, pdef, seed=seed)

@@ -29,11 +29,36 @@ from fantoch_tpu.core.workload import KeyGen, Workload
 from fantoch_tpu.engine import lockstep, setup
 
 
+# memo of finished run_once states: every run here is a pure function of
+# (protocol, shape, discipline, seed, engine env overrides), and several
+# tests below deliberately share reference runs (the fold tests re-use the
+# A/B cases' exact and fast runs) — re-running them on this 1-core host
+# would re-pay a full engine trace+compile+run per duplicate
+_RUN_MEMO = {}
+
+
+def _engine_env_key(exact):
+    """The engine-discipline env overrides that change the program,
+    normalized to their effective values (lockstep.py reads these at build
+    time; on the CPU test backend ROW_LOOP defaults on, and FOLD only
+    exists on the fast path)."""
+    rl = os.environ.get("FANTOCH_ROW_LOOP")
+    return (
+        bool(exact),
+        rl if rl is not None else "1",  # CPU default: row loop on
+        "1" if exact else os.environ.get("FANTOCH_FOLD", "1"),
+    )
+
+
 def run_once(proto_mod, *, exact, open_loop=False, n=3, f=1, cmds=6,
              window=None, seed=0):
     # cmds=6 keeps every A/B equality assertion (they are shape-independent)
     # while roughly halving the exact-loop run that dominates this file's
     # wall time (round-4 test-tier budget, see conftest.py)
+    key = (proto_mod.__name__, open_loop, n, f, cmds, window, seed,
+           _engine_env_key(exact))
+    if key in _RUN_MEMO:
+        return _RUN_MEMO[key]
     planet = Planet.new()
     name = proto_mod.__name__.rsplit(".", 1)[-1]
     config = Config(n=n, f=f, gc_interval_ms=20,
@@ -68,7 +93,9 @@ def run_once(proto_mod, *, exact, open_loop=False, n=3, f=1, cmds=6,
         st = jax.jit(lockstep.make_run(spec, pdef, wl))(env)
     finally:
         os.environ.pop("FANTOCH_EXACT", None)
-    return jax.tree_util.tree_map(np.asarray, st)
+    st = jax.tree_util.tree_map(np.asarray, st)
+    _RUN_MEMO[key] = st
+    return st
 
 
 CASES = [
@@ -136,6 +163,45 @@ def test_fold_matches_single_pop():
     # folding must actually fold on this shape (consume >1 event in some
     # trip), not agree by never engaging
     assert int(b.iters) < int(c.iters) < int(a.iters)
+
+
+@pytest.mark.parametrize("proto", ["tempo", "atlas"])
+def test_fold_matches_nofold_tempo_atlas(proto):
+    """lockstep.py enables FANTOCH_FOLD generally (any fast-path, fault-free
+    spec), so the fold observable-equality pin must cover more than basic:
+    tempo (table executor, detached votes) and atlas (graph executor) at
+    small shapes. Fold and no-fold run the SAME lookahead discipline — fold
+    may only change which trip consumes an event — so every observable,
+    including the cross-replica execution-order hashes, must be
+    bit-identical (no tie tolerance: unlike the exact-vs-lookahead A/B
+    above, no schedule change is permitted here). FOLD=2 (one fold step)
+    engages the fold machinery at roughly a third of FOLD=4's traced
+    handler invocations — the basic test above keeps the deeper FOLD=4
+    program pinned; these pin the per-protocol handler/executor equality."""
+    from fantoch_tpu.protocols import atlas, tempo
+
+    mod = {"tempo": tempo, "atlas": atlas}[proto]
+    prior = os.environ.get("FANTOCH_FOLD")
+    os.environ["FANTOCH_FOLD"] = "2"
+    try:
+        b = run_once(mod, exact=False, window=12)
+    finally:
+        if prior is None:
+            os.environ.pop("FANTOCH_FOLD", None)
+        else:
+            os.environ["FANTOCH_FOLD"] = prior
+    c = run_once(mod, exact=False, window=12)
+    assert bool(b.all_done) and bool(c.all_done)
+    assert int(b.dropped) == 0 and int(c.dropped) == 0
+    np.testing.assert_array_equal(c.lat_cnt, b.lat_cnt)
+    np.testing.assert_array_equal(c.lat_sum, b.lat_sum)
+    np.testing.assert_array_equal(c.hist, b.hist)
+    oh = getattr(c.exec, "order_hash", None)
+    if oh is not None:
+        np.testing.assert_array_equal(oh, b.exec.order_hash)
+    # folding may not engage on every shape (it is gated by timers, pending
+    # submits and component structure), but it must never ADD trips
+    assert int(b.iters) <= int(c.iters)
 
 
 def test_row_schedules_agree():

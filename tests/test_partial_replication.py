@@ -24,10 +24,12 @@ CMDS = 20
 
 def run_proto_shards(
     proto_mod, shards, kpc, conflict, cmds=CMDS, clients_per_region=1,
-    **config_kw,
+    engine_runs=None, **config_kw,
 ):
     """Shared drive: build one protocol instance over `shards` shards and run
-    the standard two-region client placement through the event engine."""
+    the standard two-region client placement through the event engine
+    (`engine_runs`: the conftest session fixture — one compiled engine per
+    (protocol, shape) shared across this file and test_quantum_runner.py)."""
     planet = Planet.new()
     config = Config(n=3, f=1, shard_count=shards, gc_interval_ms=100, **config_kw)
     wl = Workload(
@@ -50,23 +52,27 @@ def run_proto_shards(
         clients_per_region,
     )
     env = setup.build_env(spec, config, planet, placement, wl, pdef)
-    st = jax.jit(lockstep.make_run(spec, pdef, wl))(env)
+    run = (engine_runs(spec, pdef, wl) if engine_runs
+           else jax.jit(lockstep.make_run(spec, pdef, wl)))
+    st = run(env)
     st = jax.tree_util.tree_map(np.asarray, st)
     summary.check_sim_health(st)
     return st, env, spec
 
 
-def run_shards(shards, kpc, conflict, clients_per_region=1):
+def run_shards(shards, kpc, conflict, clients_per_region=1,
+               engine_runs=None):
     return run_proto_shards(
         basic_proto, shards, kpc, conflict,
-        clients_per_region=clients_per_region,
+        clients_per_region=clients_per_region, engine_runs=engine_runs,
     )
 
 
-def test_two_shards_single_key_commands_complete():
+def test_two_shards_single_key_commands_complete(engine_runs):
     # kpc=1: every command lives in exactly one shard; both shards serve
     # their own streams and every client completes
-    st, env, spec = run_shards(shards=2, kpc=1, conflict=50)
+    st, env, spec = run_shards(shards=2, kpc=1, conflict=50,
+                               engine_runs=engine_runs)
     assert int(st.c_done.sum()) == st.c_done.shape[0]
     np.testing.assert_array_equal(st.lat_cnt, CMDS)
     # commands were actually split across both shards' coordinators
@@ -77,10 +83,11 @@ def test_two_shards_single_key_commands_complete():
     assert shard0 + shard1 == st.c_done.shape[0] * CMDS
 
 
-def test_two_shards_spanning_commands_complete():
+def test_two_shards_spanning_commands_complete(engine_runs):
     # kpc=2 with a 2-key conflict pool: many commands span both shards and
     # need the forward-submit path plus cross-shard result aggregation
-    st, env, spec = run_shards(shards=2, kpc=2, conflict=50)
+    st, env, spec = run_shards(shards=2, kpc=2, conflict=50,
+                               engine_runs=engine_runs)
     assert int(st.c_done.sum()) == st.c_done.shape[0]
     np.testing.assert_array_equal(st.lat_cnt, CMDS)
     check_shard_stable(st, spec)
@@ -92,8 +99,9 @@ def test_two_shards_spanning_commands_complete():
     assert (commits[:3] > 0).all() and (commits[3:] > 0).all(), commits
 
 
-def test_single_shard_latency_unchanged_by_shard_plumbing():
-    st, env, spec = run_shards(shards=1, kpc=1, conflict=100)
+def test_single_shard_latency_unchanged_by_shard_plumbing(engine_runs):
+    st, env, spec = run_shards(shards=1, kpc=1, conflict=100,
+                               engine_runs=engine_runs)
     lat = summary.client_latencies(st, env, ["us-west1", "us-west2"])
     assert lat["us-west1"][1].mean() == 34.0
     assert lat["us-west2"][1].mean() == 58.0
@@ -117,23 +125,26 @@ def test_mismatched_shard_instance_rejected():
         setup.build_spec(config, wl, pdef, n_clients=2, n_client_groups=2)
 
 
-def run_tempo_shards(shards, kpc, conflict, cmds=15):
-    return run_proto_shards(tempo_proto, shards, kpc, conflict, cmds=cmds)
+def run_tempo_shards(shards, kpc, conflict, cmds=15, engine_runs=None):
+    return run_proto_shards(tempo_proto, shards, kpc, conflict, cmds=cmds,
+                            engine_runs=engine_runs)
 
 
 @pytest.mark.heavy
-def test_tempo_two_shards_single_key_commands():
-    st, env, spec = run_tempo_shards(shards=2, kpc=1, conflict=50)
+def test_tempo_two_shards_single_key_commands(engine_runs):
+    st, env, spec = run_tempo_shards(shards=2, kpc=1, conflict=50,
+                                     engine_runs=engine_runs)
     assert int(st.c_done.sum()) == 2
     np.testing.assert_array_equal(st.lat_cnt, 15)
     used = st.next_seq - 1
     assert used[:3].sum() > 0 and used[3:].sum() > 0, used
 
 
-def test_tempo_two_shards_spanning_commands():
+def test_tempo_two_shards_spanning_commands(engine_runs):
     # kpc=2 over a 2-key pool: commands span both shards, exercising
     # MForwardSubmit + MShardCommit aggregation + per-shard stability
-    st, env, spec = run_tempo_shards(shards=2, kpc=2, conflict=50)
+    st, env, spec = run_tempo_shards(shards=2, kpc=2, conflict=50,
+                                     engine_runs=engine_runs)
     assert int(st.c_done.sum()) == 2
     np.testing.assert_array_equal(st.lat_cnt, 15)
     check_shard_stable(st, spec)
@@ -141,20 +152,23 @@ def test_tempo_two_shards_spanning_commands():
     assert (commits[:3] > 0).all() and (commits[3:] > 0).all(), commits
 
 
-def test_tempo_single_shard_goldens_unchanged():
-    st, env, spec = run_tempo_shards(shards=1, kpc=1, conflict=100)
+def test_tempo_single_shard_goldens_unchanged(engine_runs):
+    st, env, spec = run_tempo_shards(shards=1, kpc=1, conflict=100,
+                                     engine_runs=engine_runs)
     assert int(st.c_done.sum()) == 2
     # n=3 f=1 always takes the fast path (protocol/mod.rs expectations)
     assert int(np.asarray(st.proto.slow_count).sum()) == 0
     assert int(np.asarray(st.proto.fast_count).sum()) > 0
 
 
-def run_graph_shards(proto_mod, shards, kpc, conflict, cmds=15):
+def run_graph_shards(proto_mod, shards, kpc, conflict, cmds=15,
+                     engine_runs=None):
     """Atlas/EPaxos under partial replication: MForwardSubmit + shard dep-set
     union (MShardCommit/MShardAggregatedCommit) + the graph executor's
     cross-shard dependency requests (executor/graph/mod.rs:34-43)."""
     return run_proto_shards(
         proto_mod, shards, kpc, conflict, cmds=cmds,
+        engine_runs=engine_runs,
         executor_executed_notification_interval_ms=10,
     )
 
@@ -202,10 +216,11 @@ def check_shard_order_agreement(st, spec):
 
 
 @pytest.mark.heavy
-def test_atlas_two_shards_single_key_commands():
+def test_atlas_two_shards_single_key_commands(engine_runs):
     from fantoch_tpu.protocols import atlas as atlas_proto
 
-    st, env, spec = run_graph_shards(atlas_proto, shards=2, kpc=1, conflict=50)
+    st, env, spec = run_graph_shards(atlas_proto, shards=2, kpc=1, conflict=50,
+                                     engine_runs=engine_runs)
     assert int(st.c_done.sum()) == 2
     np.testing.assert_array_equal(st.lat_cnt, 15)
     used = st.next_seq - 1
@@ -213,10 +228,11 @@ def test_atlas_two_shards_single_key_commands():
     check_shard_order_agreement(st, spec)
 
 
-def test_atlas_two_shards_spanning_commands():
+def test_atlas_two_shards_spanning_commands(engine_runs):
     from fantoch_tpu.protocols import atlas as atlas_proto
 
-    st, env, spec = run_graph_shards(atlas_proto, shards=2, kpc=2, conflict=50)
+    st, env, spec = run_graph_shards(atlas_proto, shards=2, kpc=2, conflict=50,
+                                     engine_runs=engine_runs)
     assert int(st.c_done.sum()) == 2
     np.testing.assert_array_equal(st.lat_cnt, 15)
     commits = np.asarray(st.proto.commit_count)
@@ -229,19 +245,21 @@ def test_atlas_two_shards_spanning_commands():
 
 
 @pytest.mark.heavy
-def test_epaxos_two_shards_spanning_commands():
+def test_epaxos_two_shards_spanning_commands(engine_runs):
     from fantoch_tpu.protocols import epaxos as epaxos_proto
 
-    st, env, spec = run_graph_shards(epaxos_proto, shards=2, kpc=2, conflict=50)
+    st, env, spec = run_graph_shards(epaxos_proto, shards=2, kpc=2, conflict=50,
+                                     engine_runs=engine_runs)
     assert int(st.c_done.sum()) == 2
     np.testing.assert_array_equal(st.lat_cnt, 15)
     check_shard_order_agreement(st, spec)
 
 
-def test_atlas_single_shard_unchanged_by_shard_plumbing():
+def test_atlas_single_shard_unchanged_by_shard_plumbing(engine_runs):
     from fantoch_tpu.protocols import atlas as atlas_proto
 
-    st, env, spec = run_graph_shards(atlas_proto, shards=1, kpc=1, conflict=100)
+    st, env, spec = run_graph_shards(atlas_proto, shards=1, kpc=1, conflict=100,
+                                     engine_runs=engine_runs)
     assert int(st.c_done.sum()) == 2
     assert int(np.asarray(st.proto.slow_count).sum()) == 0
     assert int(np.asarray(st.proto.fast_count).sum()) > 0

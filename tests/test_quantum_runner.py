@@ -52,12 +52,12 @@ def build(n, f, cmds, clients_per_region):
     return spec, pdef, wl, env
 
 
-def test_quantum_runner_matches_event_engine():
+def test_quantum_runner_matches_event_engine(engine_runs):
     n, f, cmds, cpr = 8, 1, 12, 2
     spec, pdef, wl, env = build(n, f, cmds, cpr)
 
-    # single-chip event engine
-    st = jax.jit(lockstep.make_run(spec, pdef, wl))(env)
+    # single-chip event engine (session-cached compile, conftest.py)
+    st = engine_runs(spec, pdef, wl)(env)
     st = jax.tree_util.tree_map(np.asarray, st)
     summary.check_sim_health(st)
 
@@ -96,10 +96,13 @@ def test_quantum_runner_matches_event_engine():
     )
 
 
-def _run_both_engines(pdef, config, wl=None, process_regions=None, cmds=8):
+def _run_both_engines(pdef, config, wl=None, process_regions=None, cmds=8,
+                      engine_runs=None):
     """Run one 8-process config (single- or multi-shard) under the event
     engine and the quantum runner; returns (engine_state, runner_state) as
-    numpy pytrees after asserting equal latency histograms."""
+    numpy pytrees after asserting equal latency histograms. `engine_runs`
+    (the conftest session fixture) shares one compiled engine per
+    (protocol, shape) across this file and test_partial_replication.py."""
     n = config.n * config.shard_count
     planet = Planet.new()
     wl = wl or Workload(1, KeyGen.conflict_pool(50, 2), 1, cmds)
@@ -112,7 +115,9 @@ def _run_both_engines(pdef, config, wl=None, process_regions=None, cmds=8):
     )
     env = setup.build_env(spec, config, planet, placement, wl, pdef)
 
-    st = jax.jit(lockstep.make_run(spec, pdef, wl))(env)
+    run = (engine_runs(spec, pdef, wl) if engine_runs
+           else jax.jit(lockstep.make_run(spec, pdef, wl)))
+    st = run(env)
     st = jax.tree_util.tree_map(np.asarray, st)
     summary.check_sim_health(st)
 
@@ -135,14 +140,15 @@ def _run_both_engines(pdef, config, wl=None, process_regions=None, cmds=8):
 
 
 @pytest.mark.heavy
-def test_quantum_runner_matches_event_engine_tempo():
+def test_quantum_runner_matches_event_engine_tempo(engine_runs):
     """The runner is protocol-generic: the flagship protocol (Tempo, with
     its table executor, detached votes, and synod slow path) produces the
     same histograms and protocol counters as the event engine."""
     from fantoch_tpu.protocols import tempo as tempo_proto
 
     st, rst = _run_both_engines(
-        tempo_proto.make_protocol(8, 1), Config(n=8, f=1, gc_interval_ms=100)
+        tempo_proto.make_protocol(8, 1), Config(n=8, f=1, gc_interval_ms=100),
+        engine_runs=engine_runs,
     )
     for counter in ("commit_count", "fast_count", "slow_count"):
         np.testing.assert_array_equal(
@@ -152,14 +158,15 @@ def test_quantum_runner_matches_event_engine_tempo():
 
 
 @pytest.mark.heavy
-def test_quantum_runner_matches_event_engine_atlas():
+def test_quantum_runner_matches_event_engine_atlas(engine_runs):
     """Dependency-graph protocols under the runner: per-key dep tracking,
     quorum threshold checks, and the graph executor's closure ordering
     match the event engine exactly."""
     from fantoch_tpu.protocols import atlas as atlas_proto
 
     st, rst = _run_both_engines(
-        atlas_proto.make_protocol(8, 1), Config(n=8, f=1, gc_interval_ms=100)
+        atlas_proto.make_protocol(8, 1), Config(n=8, f=1, gc_interval_ms=100),
+        engine_runs=engine_runs,
     )
     for counter in ("commit_count", "fast_count", "slow_count"):
         np.testing.assert_array_equal(
@@ -175,7 +182,7 @@ def test_quantum_runner_matches_event_engine_atlas():
 
 
 @pytest.mark.heavy
-def test_quantum_runner_matches_event_engine_caesar():
+def test_quantum_runner_matches_event_engine_caesar(engine_runs):
     """The wait-condition protocol under the runner: MUnblock self-send
     cascades, retry aggregation, and the predecessors executor match the
     event engine."""
@@ -184,6 +191,7 @@ def test_quantum_runner_matches_event_engine_caesar():
     st, rst = _run_both_engines(
         caesar_proto.make_protocol(8, 1, max_seq=16),
         Config(n=8, f=1, gc_interval_ms=100),
+        engine_runs=engine_runs,
     )
     for counter in ("commit_count", "stable_count"):
         np.testing.assert_array_equal(
@@ -195,7 +203,7 @@ def test_quantum_runner_matches_event_engine_caesar():
     )
 
 
-def test_quantum_runner_matches_event_engine_caesar_colocated():
+def test_quantum_runner_matches_event_engine_caesar_colocated(engine_runs):
     """Caesar with COLOCATED (0 ms apart) processes — the configuration
     class that breaks same-instant tie-order bugs loose (every quorum reply
     and unblock cascade lands in the same instant, so the wait condition,
@@ -218,6 +226,7 @@ def test_quantum_runner_matches_event_engine_caesar_colocated():
         # EVERY instant a tie regardless of run length) at half the 1-core
         # wall time
         cmds=5,
+        engine_runs=engine_runs,
     )
     for counter in ("commit_count", "stable_count"):
         np.testing.assert_array_equal(
@@ -229,7 +238,8 @@ def test_quantum_runner_matches_event_engine_caesar_colocated():
     )
 
 
-def _run_both_engines_sharded(make_pdef, config, kpc=2, cmds=8):
+def _run_both_engines_sharded(make_pdef, config, kpc=2, cmds=8,
+                              engine_runs=None):
     """Two-shard config (ranks x shards == 8 devices): spanning commands
     exercise submit forwarding, per-shard agreement, cross-shard result
     aggregation, and (for graph protocols) executor dep requests under the
@@ -237,14 +247,15 @@ def _run_both_engines_sharded(make_pdef, config, kpc=2, cmds=8):
     shards = config.shard_count
     wl = Workload(shards, KeyGen.conflict_pool(50, 2), kpc, cmds)
     pdef = make_pdef(config.n * shards, wl.keys_per_command, shards)
-    return _run_both_engines(pdef, config, wl=wl)
+    return _run_both_engines(pdef, config, wl=wl, engine_runs=engine_runs)
 
 
 @pytest.mark.heavy
-def test_quantum_runner_matches_event_engine_basic_sharded():
+def test_quantum_runner_matches_event_engine_basic_sharded(engine_runs):
     st, rst = _run_both_engines_sharded(
         lambda n, kpc, s: basic_proto.make_protocol(n, kpc, shards=s),
         Config(n=4, f=1, shard_count=2, gc_interval_ms=100),
+        engine_runs=engine_runs,
     )
     np.testing.assert_array_equal(
         np.asarray(rst.proto.commit_count), np.asarray(st.proto.commit_count)
@@ -256,12 +267,13 @@ def test_quantum_runner_matches_event_engine_basic_sharded():
 
 
 @pytest.mark.heavy
-def test_quantum_runner_matches_event_engine_tempo_sharded():
+def test_quantum_runner_matches_event_engine_tempo_sharded(engine_runs):
     from fantoch_tpu.protocols import tempo as tempo_proto
 
     st, rst = _run_both_engines_sharded(
         lambda n, kpc, s: tempo_proto.make_protocol(n, kpc, shards=s),
         Config(n=4, f=1, shard_count=2, gc_interval_ms=100),
+        engine_runs=engine_runs,
     )
     for counter in ("commit_count", "fast_count", "slow_count"):
         np.testing.assert_array_equal(
@@ -271,7 +283,7 @@ def test_quantum_runner_matches_event_engine_tempo_sharded():
 
 
 @pytest.mark.heavy
-def test_quantum_runner_matches_event_engine_atlas_sharded():
+def test_quantum_runner_matches_event_engine_atlas_sharded(engine_runs):
     from fantoch_tpu.protocols import atlas as atlas_proto
 
     st, rst = _run_both_engines_sharded(
@@ -280,6 +292,7 @@ def test_quantum_runner_matches_event_engine_atlas_sharded():
             n=4, f=1, shard_count=2, gc_interval_ms=100,
             executor_executed_notification_interval_ms=10,
         ),
+        engine_runs=engine_runs,
     )
     for counter in ("commit_count", "fast_count", "slow_count"):
         np.testing.assert_array_equal(
@@ -310,7 +323,7 @@ def test_quantum_runner_matches_event_engine_fpaxos():
 
 
 @pytest.mark.heavy
-def test_quantum_runner_matches_event_engine_open_loop():
+def test_quantum_runner_matches_event_engine_open_loop(engine_runs):
     """Open-loop clients under the runner: interval ticks at the owner
     device, per-rifl latency bookkeeping, and completion counting match the
     event engine's histograms exactly."""
@@ -326,7 +339,7 @@ def test_quantum_runner_matches_event_engine_open_loop():
     placement = setup.Placement(PROCESS_REGIONS[:n], CLIENT_REGIONS, 1)
     env = setup.build_env(spec, config, planet, placement, wl, pdef)
 
-    st = jax.jit(lockstep.make_run(spec, pdef, wl))(env)
+    st = engine_runs(spec, pdef, wl)(env)
     st = jax.tree_util.tree_map(np.asarray, st)
     summary.check_sim_health(st)
 
@@ -341,7 +354,7 @@ def test_quantum_runner_matches_event_engine_open_loop():
 
 
 @pytest.mark.heavy
-def test_quantum_runner_matches_event_engine_open_loop_sharded():
+def test_quantum_runner_matches_event_engine_open_loop_sharded(engine_runs):
     """Open loop x partial replication: concurrent outstanding rifls each
     aggregate KPC=2 partials across two shards at the owner device
     (per-rifl c_got slots) — histograms and commits match the engine."""
@@ -356,7 +369,7 @@ def test_quantum_runner_matches_event_engine_open_loop_sharded():
     placement = setup.Placement(PROCESS_REGIONS[:4], CLIENT_REGIONS, 1)
     env = setup.build_env(spec, config, planet, placement, wl, pdef)
 
-    st = jax.jit(lockstep.make_run(spec, pdef, wl))(env)
+    st = engine_runs(spec, pdef, wl)(env)
     st = jax.tree_util.tree_map(np.asarray, st)
     summary.check_sim_health(st)
 

@@ -1,0 +1,62 @@
+"""Tier-1 guard for the bench driver: `bench.py --smoke`.
+
+Round 5 lost its headline number to bench-DRIVER regressions (per-protocol
+fixed costs eating the timed budget, goldens competing with timed slices)
+that no test caught because the bench only ever ran on the real chip at the
+end of a round. This smoke pass runs the full driver stack — persistent
+warm worker, golden side-budget phase, megachunk timed loop, incremental
+aggregates — over all six protocols at tiny shapes on the CPU backend, so
+driver breakage fails HERE, in CI, instead of in the next round's 1080 s
+device run.
+"""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PROTOCOLS = {"basic", "tempo", "atlas", "epaxos", "fpaxos", "caesar"}
+
+
+def test_bench_smoke_all_six_protocols():
+    env = dict(os.environ)
+    env.pop("BENCH_PROTOCOLS", None)  # the smoke must cover all six
+    env.setdefault("BENCH_BUDGET_S", "540")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke"],
+        capture_output=True, text=True, timeout=660, cwd=REPO, env=env,
+    )
+    sys.stderr.write(proc.stderr[-4000:])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+    # the LAST aggregate line is the bench's contract with the driver: it
+    # must parse, cover all six protocols with nonzero events, and carry no
+    # partial marker
+    last = None
+    for line in proc.stdout.splitlines():
+        try:
+            cand = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(cand, dict) and "per_protocol" in cand:
+            last = cand
+    assert last is not None, f"no aggregate line on stdout:\n{proc.stdout}"
+    assert last.get("smoke") is True
+    assert not last.get("partial"), last
+    assert set(last["per_protocol"]) == PROTOCOLS
+    for name, rec in last["per_protocol"].items():
+        assert rec["events"] > 0, (name, rec)
+        assert rec["wall_s"] > 0, (name, rec)
+
+    # incremental aggregates: at least one partial line must precede the
+    # final one (the crash-containment property the round-4/5 benches
+    # relied on to stay parseable under an external kill)
+    partials = [
+        ln for ln in proc.stdout.splitlines()
+        if '"partial": true' in ln
+    ]
+    assert partials, "no incremental aggregate lines were printed"
+
+    # the golden phase ran (side budget) and passed on the CPU backend
+    assert "device goldens: ok" in proc.stderr

@@ -1,0 +1,210 @@
+"""Megachunk driver equivalence (engine `run_megachunk` / sweep
+`make_megachunk_runner`).
+
+The megachunk driver folds up to `k` chunk segments into ONE device call
+with the done-predicate evaluated on device, returning the state plus a
+scalar int8 done flag — the host syncs on one byte per megachunk instead of
+materializing the full batched SimState per chunk. These tests pin the two
+claims the bench builds on:
+
+- BIT-IDENTITY: megachunk(k) produces exactly the state of k sequential
+  `run_chunk` calls (each segment recomputes its step limit from the state
+  at segment entry, so segment boundaries — where a trip may overshoot the
+  limit — land on the same trips), including the early-exit at done and the
+  `max_steps` clamp;
+- DISPATCH REDUCTION: the host loop completes in ~chunks/k dispatches (the
+  O(chunks) -> O(megachunks) host-sync drop the bench claims).
+
+Plus donation safety: the non-donating chunked path still supports
+`save_state`/`load_state` checkpointing (snapshot semantics), while the
+donating megachunk path deletes its input state buffers (in-place update).
+"""
+import jax
+import numpy as np
+import pytest
+
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.planet import Planet
+from fantoch_tpu.core.workload import KeyGen, Workload
+from fantoch_tpu.engine import setup, sweep
+
+CHUNK = 150
+K = 3
+
+# module-level caches: every runner is one sizeable compiled program on this
+# 1-core CI host, and several tests below share (protocol, shape, k) —
+# rebuild nothing twice inside one session
+_BUILDS = {}
+_CHUNKED = {}
+_MEGA = {}
+
+
+def build(proto, cmds=20, max_steps=200_000):
+    key = (proto, cmds, max_steps)
+    if key in _BUILDS:
+        return _BUILDS[key]
+    from fantoch_tpu.protocols import basic, fpaxos, tempo
+
+    mod = {"basic": basic, "tempo": tempo, "fpaxos": fpaxos}[proto]
+    planet = Planet.new()
+    leader = 1 if proto == "fpaxos" else None
+    config = Config(n=3, f=1, gc_interval_ms=100, leader=leader)
+    wl = Workload(1, KeyGen.conflict_pool(50, 2), 1, cmds, 100)
+    pdef = mod.make_protocol(3, 1)
+    spec = setup.build_spec(
+        config, wl, pdef, n_clients=2, n_client_groups=2,
+        max_steps=max_steps, extra_ms=1000,
+        max_seq=12 if proto == "tempo" else None,
+    )
+    placement = setup.Placement(
+        ["asia-east1", "us-central1", "us-west1"], ["us-west1", "us-west2"], 1
+    )
+    envs = sweep.stack_envs([
+        setup.build_env(spec, config, planet, placement, wl, pdef, seed=s)
+        for s in (0, 1)
+    ])
+    _BUILDS[key] = (key, spec, pdef, wl, envs)
+    return _BUILDS[key]
+
+
+def chunked_runner(bkey, spec, pdef, wl, chunk_steps=CHUNK):
+    key = (bkey, chunk_steps)
+    if key not in _CHUNKED:
+        _CHUNKED[key] = sweep.make_chunked_runner(
+            spec, pdef, wl, chunk_steps, donate=False
+        )
+    return _CHUNKED[key]
+
+
+def mega_runner(bkey, spec, pdef, wl, chunk_steps=CHUNK, k=K):
+    key = (bkey, chunk_steps, k)
+    if key not in _MEGA:
+        _MEGA[key] = sweep.make_megachunk_runner(
+            spec, pdef, wl, chunk_steps, k=k
+        )
+    return _MEGA[key]
+
+
+def drive_chunked(bkey, spec, pdef, wl, envs, chunk_steps=CHUNK):
+    """Sequential host-driven chunk loop (non-donating so the caller can
+    snapshot); returns (final numpy state, dispatch count)."""
+    init, chunk, done = chunked_runner(bkey, spec, pdef, wl, chunk_steps)
+    st = init(envs)
+    n = 0
+    while not done(st):
+        st = chunk(envs, st)
+        n += 1
+        assert n < 1000
+    return jax.tree_util.tree_map(np.asarray, st), n
+
+
+def drive_mega(bkey, spec, pdef, wl, envs, chunk_steps=CHUNK, k=K):
+    init, mega = mega_runner(bkey, spec, pdef, wl, chunk_steps, k)
+    st = init(envs)
+    n = 0
+    done = 0
+    while not done:
+        st, d = mega(envs, st)
+        n += 1
+        done = int(d)
+        assert n < 1000
+    return jax.tree_util.tree_map(np.asarray, st), n
+
+
+def assert_states_equal(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(x, y)
+
+
+@pytest.mark.parametrize("proto", ["basic", "tempo", "fpaxos"])
+def test_megachunk_bit_identical_to_sequential_chunks(proto):
+    bkey, spec, pdef, wl, envs = build(proto)
+    seq, chunks = drive_chunked(bkey, spec, pdef, wl, envs)
+    mega, megas = drive_mega(bkey, spec, pdef, wl, envs)
+    assert bool(seq.all_done.all())
+    assert_states_equal(seq, mega)
+    # the host-sync drop the bench claims: O(chunks) -> O(chunks / k)
+    # (+1 tolerance: the final megachunk may be the one that observes done)
+    assert chunks > K, f"shape too small to exercise chunking ({chunks})"
+    assert megas <= -(-chunks // K) + 1, (megas, chunks)
+
+
+def test_megachunk_early_exit_at_done():
+    """A k far beyond the run length must terminate at done inside ONE
+    device call (the on-device done predicate short-circuits the outer
+    loop), with the same final state."""
+    bkey, spec, pdef, wl, envs = build("basic")
+    seq, _ = drive_chunked(bkey, spec, pdef, wl, envs)
+    mega, megas = drive_mega(bkey, spec, pdef, wl, envs, k=64)
+    assert megas == 1
+    assert_states_equal(seq, mega)
+
+
+def test_megachunk_max_steps_clamp():
+    """With max_steps below the run length both drivers stop at the clamp,
+    on the same trip, with identical (incomplete) states."""
+    bkey, spec, pdef, wl, envs = build("basic", max_steps=400)
+    seq, _ = drive_chunked(bkey, spec, pdef, wl, envs)
+    mega, _ = drive_mega(bkey, spec, pdef, wl, envs)
+    assert not bool(seq.all_done.all())  # the clamp, not completion, stopped it
+    assert int(seq.step.min()) >= 400
+    assert_states_equal(seq, mega)
+
+
+def test_nondonating_chunk_keeps_input_state_readable():
+    """donate=False is the checkpointing contract: a caller may hold a
+    pre-chunk snapshot across the call and read it afterwards (save_state
+    of an older state than the one being advanced)."""
+    bkey, spec, pdef, wl, envs = build("basic")
+    init, chunk, done = chunked_runner(bkey, spec, pdef, wl)
+    st0 = init(envs)
+    st1 = chunk(envs, st0)
+    # the input state survives the call — snapshot semantics
+    assert int(np.asarray(st0.step).sum()) == 0
+    assert int(np.asarray(st1.step).sum()) > 0
+
+
+def test_donating_runner_deletes_input_state():
+    """donate=True hands the state buffers to XLA for in-place update: the
+    input state is deleted after the call (which is the point — no [B, ...]
+    SoA copy per dispatch). Anyone who needs the old state must use the
+    non-donating path."""
+    bkey, spec, pdef, wl, envs = build("basic")
+    init, mega = mega_runner(bkey, spec, pdef, wl, k=2)
+    st0 = init(envs)
+    st1, _ = mega(envs, st0)
+    with pytest.raises(RuntimeError, match="deleted|donated"):
+        np.asarray(st0.step)
+    assert int(np.asarray(st1.step).sum()) > 0
+
+
+def test_megachunk_checkpoint_roundtrip_through_nondonating_path():
+    """save_state/load_state still round-trip through the non-donating
+    chunked runner, and a run resumed from the checkpoint then finished by
+    the DONATING megachunk driver matches an uninterrupted chunked run."""
+    bkey, spec, pdef, wl, envs = build("basic")
+    seq, _ = drive_chunked(bkey, spec, pdef, wl, envs)
+
+    init, chunk, done = chunked_runner(bkey, spec, pdef, wl)
+    st = chunk(envs, chunk(envs, init(envs)))
+    import os
+    import tempfile
+
+    fd, path = tempfile.mkstemp(suffix=".npz")
+    os.close(fd)
+    try:
+        sweep.save_state(path, st)
+        st2 = sweep.load_state(path, init(envs))
+    finally:
+        os.remove(path)
+    _, mega = mega_runner(bkey, spec, pdef, wl)
+    done_f = 0
+    n = 0
+    while not done_f:
+        st2, d = mega(envs, st2)
+        done_f = int(d)
+        n += 1
+        assert n < 1000
+    assert_states_equal(seq, jax.tree_util.tree_map(np.asarray, st2))

@@ -3,8 +3,11 @@
 `fast_aux` (engine/lockstep.py) builds the conservative-lookahead loop's
 static structures — the min-plus closure over the n + C destination space —
 once per `run` call, inside the jitted program, per config. Its cost is
-O(D^3 log D) with D = n + C, so the verdict asked for a measurement at
-C in {8, 32, 128} and a caching decision.
+O(D^3 log D) with D = n + C. The round-3 verdict asked for C in
+{8, 32, 128}; the bench placement has THREE client regions, so this tool
+sweeps the nearest per-region client counts cpr in {2, 8, 32} and measures
+C = 3 * cpr in {6, 24, 96} (each row prints its actual C — same decades,
+honest labels).
 
 This tool times, on the current default backend, a vmapped batch of
 `fast_aux` calls against one trip of the corresponding engine loop, and
@@ -36,8 +39,7 @@ def main(argv=None):
     args = ap.parse_args(argv)
     n = 3
     out = {}
-    for cpr in (2, 8, 32):  # clients per region x 3 regions + auto 4-region
-        # bench placement has 3 client regions
+    for cpr in (2, 8, 32):  # x 3 bench client regions -> C in {6, 24, 96}
         placement = setup.Placement(
             bench.PLACEMENT.process_regions,
             bench.PLACEMENT.client_regions,

@@ -11,7 +11,14 @@ serialized-kernel count, the source of `fixed`). This is the measurement
 harness behind BASELINE.md's fixed-cost analysis and the round-5 lever
 selection (VERDICT r4 weak #2 / next #2).
 
+`--drivers` compares the host-driven chunk loop against the
+device-resident megachunk driver (engine/sweep.py make_megachunk_runner)
+on a full run of one protocol: dispatch counts (host syncs), wall time,
+events/sec, and compiled HLO line counts of both programs — the
+measurement behind the bench's O(chunks) -> O(megachunks) host-sync claim.
+
 Usage:  python tools/trip_profile.py [tempo] [--batches 64,256,1024]
+        python tools/trip_profile.py tempo --drivers [--batch 64] [--mega-k 4]
 """
 import argparse
 import json
@@ -104,15 +111,117 @@ def measure(name, batches, trips=400):
     return out
 
 
+def compare_drivers(name, B=64, chunk_steps=None, k=4, cmds=25):
+    """Full run of `name` at batch B under (a) the host-driven chunk loop
+    and (b) the device-resident megachunk driver, same chunk length.
+    Reports dispatches (host syncs), wall, events/sec, HLO lines."""
+    pdef, window, leader = bench.build_protocol(name, cmds)
+    spec, wl, envs = bench.build_batch(
+        pdef, B, cmds, window, pool_slots=384, leader=leader
+    )
+    cs = chunk_steps or next(
+        (r[3] for r in bench.RUNS if r[0] == name), 2000
+    )
+
+    def hlo_lines(jitted, *a):
+        try:
+            return jitted.lower(*a).compile().as_text().count("\n")
+        except Exception:
+            return -1
+
+    out = {"batch": B, "chunk_steps": cs, "mega_k": k}
+
+    # host-driven chunk loop (one full-state-typed dispatch + host done()
+    # evaluation per chunk)
+    init, chunk, done = sweep.make_chunked_runner(
+        spec, pdef, wl, cs, donate=False
+    )
+    st0 = init(envs)
+    jax.block_until_ready(st0)
+    # warm BEFORE hlo_lines: the jit call writes the persistent compile
+    # cache, so lower().compile() (a separate AOT compile) deserializes
+    # instead of re-compiling the ~100k-line program from scratch
+    jax.block_until_ready(chunk(envs, st0))
+    chlo = hlo_lines(chunk, envs, st0)
+    t0 = time.time()
+    st = init(envs)
+    n = 0
+    while not done(st):
+        st = chunk(envs, st)
+        n += 1
+    jax.block_until_ready(st)
+    dt = time.time() - t0
+    ev = int(np.asarray(st.step).sum())
+    out["chunk"] = {
+        "dispatches": n,
+        "host_syncs": n + 1,  # done() evaluates once per chunk + the last
+        "wall_s": round(dt, 3),
+        "events": ev,
+        "events_per_sec": round(ev / max(dt, 1e-9), 1),
+        "hlo_lines": chlo,
+    }
+
+    # device-resident megachunk driver (one int8 host sync per k chunks,
+    # donated state)
+    minit, mega = sweep.make_megachunk_runner(spec, pdef, wl, cs, k=k)
+    mst0 = minit(envs)
+    jax.block_until_ready(mst0)
+    wst, wd = mega(envs, mst0)  # warm (donates mst0)
+    jax.block_until_ready(wst)
+    del wst, wd
+    mhlo = hlo_lines(mega, envs, minit(envs))
+    t0 = time.time()
+    mst = minit(envs)
+    m = 0
+    fin = 0
+    while not fin:
+        mst, d = mega(envs, mst)
+        m += 1
+        fin = int(d)
+    jax.block_until_ready(mst)
+    mdt = time.time() - t0
+    mev = int(np.asarray(mst.step).sum())
+    out["megachunk"] = {
+        "dispatches": m,
+        "host_syncs": m,  # the int8 done flag is the only per-call pull
+        "wall_s": round(mdt, 3),
+        "events": mev,
+        "events_per_sec": round(mev / max(mdt, 1e-9), 1),
+        "hlo_lines": mhlo,
+    }
+    assert mev == ev, f"driver divergence: {mev} != {ev} events"
+    out["sync_reduction"] = round((n + 1) / max(m, 1), 2)
+    print(f"{name}: chunk {n} dispatches / {dt:.2f}s vs megachunk(k={k}) "
+          f"{m} dispatches / {mdt:.2f}s -> {out['sync_reduction']}x fewer"
+          " host syncs", file=sys.stderr, flush=True)
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("protocols", nargs="*", default=["tempo"])
     ap.add_argument("--batches", default="64,256,1024")
     ap.add_argument("--trips", type=int, default=400)
+    ap.add_argument("--drivers", action="store_true",
+                    help="compare chunk loop vs megachunk driver instead of"
+                         " the per-trip fit")
+    ap.add_argument("--batch", type=int, default=64,
+                    help="batch size for --drivers")
+    ap.add_argument("--mega-k", type=int, default=4)
+    ap.add_argument("--chunk-steps", type=int, default=None)
+    ap.add_argument("--cmds", type=int, default=25,
+                    help="commands/client for --drivers")
     args = ap.parse_args()
     protos = args.protocols or ["tempo"]
-    batches = [int(x) for x in args.batches.split(",")]
-    res = {p: measure(p, batches, args.trips) for p in protos}
+    if args.drivers:
+        res = {
+            p: compare_drivers(p, args.batch, args.chunk_steps, args.mega_k,
+                               args.cmds)
+            for p in protos
+        }
+    else:
+        batches = [int(x) for x in args.batches.split(",")]
+        res = {p: measure(p, batches, args.trips) for p in protos}
     print(json.dumps(res))
 
 
