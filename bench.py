@@ -94,6 +94,14 @@ _T0 = time.time()
 # inherit the flag through the environment; `--smoke` sets it in the parent)
 SMOKE = os.environ.get("BENCH_SMOKE") == "1"
 
+# device-resident trace recorder (obs/trace.py) in the timed runs: always
+# on in smoke (tests/test_smoke_bench.py asserts the summary fields), opt-in
+# for real chip runs via BENCH_TRACE=1 (it changes the compiled program, so
+# keep headline numbers comparable by default). Tracing adds NO host syncs
+# (tools/trip_profile.py --drivers proves it); per-protocol trace summaries
+# ride the aggregate's per_protocol records.
+BENCH_TRACE = SMOKE or os.environ.get("BENCH_TRACE") == "1"
+
 # chunks folded into one device call by the megachunk driver. The RUNS chunk
 # lengths each stay well under the tunnel's ~40s stall watchdog; a megachunk
 # multiplies single-call runtime by up to this factor, so keep the product
@@ -195,8 +203,19 @@ def protocol_def(name, n, commands_per_client=None):
     }[name].make_protocol(n, 1)
 
 
+def trace_spec():
+    """The bench's TraceSpec (None when tracing is off): 250 ms windows x
+    128 cover the ~30 s simulated horizons of the RUNS shapes."""
+    if not BENCH_TRACE:
+        return None
+    from fantoch_tpu.obs.trace import TraceSpec
+
+    return TraceSpec(window_ms=250, max_windows=128)
+
+
 def build_batch(pdef, n_configs, commands_per_client, window,
-                conflict_rate=50, pool_slots=None, seed0=0, leader=None):
+                conflict_rate=50, pool_slots=None, seed0=0, leader=None,
+                trace=None):
     planet = Planet.new()
     config = Config(
         n=3, f=1, gc_interval_ms=20,
@@ -222,6 +241,7 @@ def build_batch(pdef, n_configs, commands_per_client, window,
         # clients; these placements keep ~3n messages in flight per client
         # (engine asserts dropped == 0, so undersizing is detected loudly)
         pool_slots=pool_slots,
+        trace=trace,
     )
     envs = [
         setup.build_env(spec, config, planet, PLACEMENT, workload, pdef,
@@ -347,13 +367,41 @@ def device_golden(name, cmds=6):
 # timed runs
 # ---------------------------------------------------------------------------
 
+def trace_summary_of(st, tspec):
+    """Compact trace digest of a finished batched state (None when the
+    trace recorder was off): per-channel totals summed over the batch and
+    the done-channel stall stats of the batch-summed timeline."""
+    if tspec is None or st.trace is None:
+        return None
+    from fantoch_tpu.obs import report as obs_report
+
+    out = {"window_ms": tspec.window_ms, "totals": {}}
+    for name, arr in sorted(st.trace.items()):
+        arr = np.asarray(arr)
+        out["totals"][name] = (
+            int(arr.max()) if name == "pool_hw" else int(arr.sum())
+        )
+    if "done" in st.trace:
+        done = np.asarray(st.trace["done"])
+        per_window = done.reshape(done.shape[0], tspec.max_windows, -1)
+        series = per_window.sum(axis=(0, 2))  # [W], batch-summed
+        out["windows_active"] = int((series > 0).sum())
+        out["done_max_gap_ms"] = obs_report.stall_stats(
+            series, tspec.window_ms
+        )["max_gap_ms"]
+    return out
+
+
 def timed_run(pdef, n_configs, commands_per_client, window, chunk_steps,
               pool_slots, seed0=0, leader=None):
     """Megachunk-driven timed run: up to MEGA_K chunks per device call, one
-    int8 host sync per megachunk, donated state (updated in place)."""
+    int8 host sync per megachunk, donated state (updated in place). With
+    BENCH_TRACE the device trace recorder rides in the same program —
+    identical dispatch count, summary returned alongside the rate."""
+    tspec = trace_spec()
     spec, wl, envs = build_batch(
         pdef, n_configs, commands_per_client, window,
-        pool_slots=pool_slots, seed0=seed0, leader=leader,
+        pool_slots=pool_slots, seed0=seed0, leader=leader, trace=tspec,
     )
     init, mega = sweep.make_megachunk_runner(
         spec, pdef, wl, chunk_steps, k=MEGA_K
@@ -379,14 +427,14 @@ def timed_run(pdef, n_configs, commands_per_client, window, chunk_steps,
     ok = bool(res["all_done"].all()) and int(res["dropped"].sum()) == 0
     log(f"    megachunk: {dispatches} dispatches x (<= {MEGA_K} chunks of"
         f" {chunk_steps} steps), {events} events")
-    return events, elapsed, ok
+    return events, elapsed, ok, trace_summary_of(st, tspec)
 
 
 def run_protocol(name, n_configs, commands_per_client, chunk_steps,
                  pool_slots, repeats):
     """Best-of-`repeats` timed runs with canary gating and fault retry."""
     pdef, window, leader = build_protocol(name, commands_per_client)
-    best = None  # (rate, events, elapsed, ok)
+    best = None  # (rate, events, elapsed, ok, trace)
     rates = []
     B, cs = n_configs, chunk_steps
     attempts = 0
@@ -401,7 +449,7 @@ def run_protocol(name, n_configs, commands_per_client, chunk_steps,
         try:
             # pinned seed: repeats time the SAME workload, so spread
             # measures worker noise, not workload variance
-            events, elapsed, ok = timed_run(
+            events, elapsed, ok, tsum = timed_run(
                 pdef, B, commands_per_client, window, cs, pool_slots,
                 leader=leader,
             )
@@ -419,18 +467,18 @@ def run_protocol(name, n_configs, commands_per_client, chunk_steps,
         rates.append(rate)
         # a complete run always beats an incomplete one, whatever its rate
         if best is None or (ok, rate) > (best[3], best[0]):
-            best = (rate, events, elapsed, ok)
+            best = (rate, events, elapsed, ok, tsum)
         log(f"  {name}[run {len(rates)}]: {B} configs, {events} events, "
             f"{elapsed:.1f}s -> {rate:,.0f} events/sec"
             + ("" if ok else "  [INCOMPLETE]"))
     if best is None:
         log(f"  {name}: skipped (no successful run)")
-        return 0, 0.0, False
-    rate, events, elapsed, ok = best
+        return 0, 0.0, False, None
+    rate, events, elapsed, ok, tsum = best
     spread = (max(rates) - min(rates)) / max(rates) if len(rates) > 1 else 0.0
     log(f"  {name}: best {rate:,.0f} events/sec over {len(rates)} runs "
         f"(spread {spread:.0%})")
-    return events, elapsed, ok
+    return events, elapsed, ok, tsum
 
 
 # chunk lengths keep each device call well under the tunnel's ~40s stall
@@ -522,13 +570,13 @@ def worker_main():
                 else:
                     _, n_configs, cmds, chunk_steps, pool = spec[0]
                     n_configs = max(int(n_configs * scale), 1)
-                    events, elapsed, ok = run_protocol(
+                    events, elapsed, ok, tsum = run_protocol(
                         name, n_configs, cmds,
                         int(chunk_env) if chunk_env else chunk_steps,
                         pool, repeats,
                     )
                     resp.update(events=events, wall_s=round(elapsed, 3),
-                                ok=bool(ok))
+                                ok=bool(ok), trace=tsum)
             else:
                 resp.update(ok=False, err=f"unknown op {op!r}")
         except Exception as e:  # noqa: BLE001 — soft faults stay contained
@@ -830,6 +878,7 @@ def main():
                     events=int(resp.get("events", 0)),
                     wall_s=float(resp.get("wall_s", 0.0)),
                     ok=bool(resp.get("ok")),
+                    trace=resp.get("trace"),
                 )
         all_ok &= bool(rec.get("ok"))
         events, elapsed = rec["events"], rec["wall_s"]
@@ -844,6 +893,9 @@ def main():
             "vs_cpu_core": round(
                 rate / (base if base is not None else ESTIMATED_BASELINE), 3),
             "golden": rec["golden"],
+            # device-trace digest (None when BENCH_TRACE off): per-channel
+            # totals + done-channel stall stats of the timed run
+            "trace": rec.get("trace"),
         }
         if base is None:
             per_protocol[name]["estimated"] = True

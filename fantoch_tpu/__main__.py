@@ -118,6 +118,12 @@ def cmd_sweep(args) -> int:
         print("sweep: --metrics-log snapshots are taken between chunks;"
               " pass --chunk-steps", file=sys.stderr)
         return 2
+    tspec = None
+    if args.trace:
+        from .obs.trace import TraceSpec
+
+        tspec = TraceSpec(window_ms=args.trace_window,
+                          max_windows=args.trace_windows)
 
     points = []
     for proto in _csv(args.protocols):
@@ -155,8 +161,58 @@ def cmd_sweep(args) -> int:
         verbose=args.verbose,
         profile_dir=args.profile or None,
         metrics_log=args.metrics_log or None,
+        trace=tspec,
     )
     print(json.dumps({"points": len(points), "dirs": dirs}))
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """Run one configuration with the device-resident trace recorder and
+    render its windowed timeline report (JSON on stdout; optional Markdown
+    and figure files) — the in-run observability the reference's
+    metrics_logger file provides, at megachunk speed."""
+    from .exp.harness import Point, run_point_traced
+    from .obs import report as obs_report
+    from .obs.trace import TraceSpec
+
+    pt = Point(
+        protocol=args.protocol,
+        n=args.n,
+        f=args.f,
+        clients_per_region=args.clients,
+        conflict_rate=args.conflict,
+        commands_per_client=args.commands,
+        read_only_percentage=args.read_only,
+        seed=args.seed,
+        open_loop_interval_ms=args.open_loop,
+        crash=_parse_crash(args.crash),
+        partition=_parse_partition(args.partition),
+        drop_pct=args.drop_pct,
+        dup_pct=args.dup_pct,
+        leader_check_interval_ms=args.leader_check,
+        deadline_ms=args.deadline,
+    )
+    tspec = TraceSpec(window_ms=args.window, max_windows=args.windows)
+    st, _spec, _env, cregions = run_point_traced(
+        pt,
+        tspec,
+        process_regions=_csv(args.process_regions) or None,
+        client_regions=_csv(args.client_regions) or None,
+    )
+    rep = obs_report.drain(st, tspec, cregions)
+    print(obs_report.render_json(rep))
+    if args.md:
+        with open(args.md, "w") as f:
+            f.write(obs_report.render_markdown(
+                rep, title=f"trace — {args.protocol}"
+            ))
+        print(f"markdown: {args.md}", file=sys.stderr)
+    if args.plot:
+        from .plot.plots import trace_timeline
+
+        trace_timeline(rep, args.plot)
+        print(f"figure: {args.plot}", file=sys.stderr)
     return 0
 
 
@@ -428,9 +484,52 @@ def main(argv=None) -> int:
                     help="wrap device runs in jax.profiler.trace to this dir"
                          " (the flamegraph run-mode analogue)")
     pw.add_argument("--metrics-log", default="",
-                    help="append per-chunk metric snapshots to this file"
-                         " (requires --chunk-steps; metrics_logger analogue)")
+                    help="LEGACY: append per-chunk metric snapshots to this"
+                         " file (requires --chunk-steps and forces the"
+                         " host-driven chunk loop; prefer --trace, which"
+                         " records on device at megachunk speed)")
+    pw.add_argument("--trace", action="store_true",
+                    help="compile the device-resident windowed trace"
+                         " recorder into every bucket (obs/trace.py);"
+                         " arrays land in data.npz, reports in trace.json/"
+                         "trace.md per results dir")
+    pw.add_argument("--trace-window", type=int, default=100,
+                    help="trace window size ms")
+    pw.add_argument("--trace-windows", type=int, default=64,
+                    help="trace window count")
     pw.set_defaults(fn=cmd_sweep)
+
+    pt = sub.add_parser(
+        "trace",
+        help="run one config with the device trace recorder, print the"
+             " windowed timeline report",
+    )
+    pt.add_argument("--protocol", required=True)
+    pt.add_argument("--n", type=int, default=3)
+    pt.add_argument("--f", type=int, default=1)
+    pt.add_argument("--clients", type=int, default=1)
+    pt.add_argument("--conflict", type=int, default=0)
+    pt.add_argument("--commands", type=int, default=20)
+    pt.add_argument("--read-only", type=int, default=0)
+    pt.add_argument("--seed", type=int, default=0)
+    pt.add_argument("--open-loop", type=int, default=0,
+                    help="open-loop tick interval ms (0 = closed loop)")
+    pt.add_argument("--window", type=int, default=100,
+                    help="trace window size ms")
+    pt.add_argument("--windows", type=int, default=64,
+                    help="trace window count")
+    pt.add_argument("--crash", action="append", default=[],
+                    metavar="P@T0[:T1]")
+    pt.add_argument("--partition", default="", metavar="A,B,..@T0:T1")
+    pt.add_argument("--drop-pct", type=int, default=0)
+    pt.add_argument("--dup-pct", type=int, default=0)
+    pt.add_argument("--leader-check", type=int, default=0)
+    pt.add_argument("--deadline", type=int, default=0)
+    pt.add_argument("--process-regions", default="")
+    pt.add_argument("--client-regions", default="")
+    pt.add_argument("--md", default="", help="write a Markdown report here")
+    pt.add_argument("--plot", default="", help="write a timeline figure here")
+    pt.set_defaults(fn=cmd_trace)
 
     pp = sub.add_parser("plot", help="figures + stats from a results root")
     pp.add_argument("--results", default="results")

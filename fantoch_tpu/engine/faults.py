@@ -23,9 +23,12 @@ field:
 
 Failure *detection* is perfect and instantaneous: the schedule is part of
 `Env`, so quorum selection (`dynamic_masks`) can avoid processes that are
-crashed at the handling instant — the strongest failure detector, the
-standard simplification for deterministic simulation. Commands whose
-quorums were fixed before a member crashed (the masks ride in message
+crashed — or across an active partition cut — at the handling instant: the
+strongest failure detector, the standard simplification for deterministic
+simulation. Partition windows feed the detector the same way crashes do
+(each side picks quorums from its own side while the window is open, and
+the static quorums return once it heals). Commands whose quorums were
+fixed before a member crashed or was cut off (the masks ride in message
 payloads) stall rather than re-form: safety over liveness, exactly the
 reference's contract.
 
@@ -138,11 +141,20 @@ def crash_deferred_time(env, proc, t):
 
 
 def alive_matrix(env, now_rows):
-    """[n, n] bool: is column process q alive at row p's instant
-    `now_rows[p]`."""
+    """[n, n] bool: is column process q AVAILABLE to row p at p's instant
+    `now_rows[p]` — alive (outside its crash window) and reachable (not
+    across an active partition cut from p). Partition windows feed the
+    perfect failure detector exactly like crashes: during the window each
+    side's quorum selection avoids the other side, and when the window
+    heals the static reachability (and hence the static quorums) return."""
     t = jnp.asarray(now_rows)[:, None]
     dead = (t >= env.crash_at[None, :]) & (t < env.recover_at[None, :])
-    return ~dead
+    rows = jnp.arange(env.crash_at.shape[0], dtype=jnp.int32)
+    in_part = (t >= env.part_from) & (t < env.part_until)  # [n, 1]
+    across = (bit(env.part_a, rows[:, None]) == 1) != (
+        bit(env.part_a, rows[None, :]) == 1
+    )
+    return ~(dead | (in_part & across))
 
 
 def _hash_pct(x, salt):
@@ -213,14 +225,16 @@ def normalize_per_next(env, per_next, interval_arr):
 
 
 def dynamic_masks(env, n, now_rows):
-    """Quorum masks recomputed to avoid crashed processes — the perfect
-    failure detector feeding quorum selection. Returns `(fq, wq, maj)`
-    `[n]` int32 bitmasks: for each row p at its instant `now_rows[p]`, the
-    first `fq/wq/majority`-many ALIVE same-shard processes of p's
-    distance-sorted order (exactly `build_env`'s static construction with
-    crashed members skipped). When fewer members than a quorum size are
-    alive, the mask is short and acks can never reach the size — progress
-    stalls without a safety violation, the f-fault-tolerance contract."""
+    """Quorum masks recomputed to avoid crashed or partitioned-away
+    processes — the perfect failure detector feeding quorum selection.
+    Returns `(fq, wq, maj)` `[n]` int32 bitmasks: for each row p at its
+    instant `now_rows[p]`, the first `fq/wq/majority`-many AVAILABLE
+    same-shard processes of p's distance-sorted order (exactly
+    `build_env`'s static construction with crashed members and processes
+    across an active partition cut skipped). When fewer members than a
+    quorum size are available, the mask is short and acks can never reach
+    the size — progress stalls without a safety violation, the
+    f-fault-tolerance contract."""
     alive = alive_matrix(env, now_rows)  # [n, n] by global index
     order = env.sorted_procs  # [n, n] static
     ohp = dense.oh(order, n)  # [n, n, n] position -> member one-hot
@@ -255,6 +269,12 @@ def dynamic_masks_row(env, n, pid, now):
     is what keeps the two engines' quorum picks equal."""
     t = jnp.asarray(now)
     alive = ~((t >= env.crash_at) & (t < env.recover_at))  # [n]
+    # partition cut: peers across the cut are unavailable to pid during
+    # the window (same rule as alive_matrix row pid)
+    others = jnp.arange(env.crash_at.shape[0], dtype=jnp.int32)
+    in_part = (t >= env.part_from) & (t < env.part_until)
+    across = (bit(env.part_a, pid) == 1) != (bit(env.part_a, others) == 1)
+    alive = alive & ~(in_part & across)
     order = dense.dget(env.sorted_procs, pid)  # [n]
     in_shard = ((dense.dget(env.all_mask, pid) >> order) & 1) == 1
     alive_of = jnp.any(dense.oh(order, n) & alive[None, :], axis=1)

@@ -59,6 +59,7 @@ import jax.numpy as jnp
 
 from ..core import workload as workload_mod
 from ..core import ids
+from ..obs import trace as trace_mod
 from ..ops import dense
 from . import faults as faults_mod
 from .types import (
@@ -84,7 +85,8 @@ _BIG = jnp.int32(2**30)
 # fingerprints record it (exp/harness.py) so stale buckets from an older
 # contract re-run instead of silently mixing. Pure scheduling changes that
 # the A/B equality suite proves unobservable do NOT bump it.
-ENGINE_CONTRACT = 4
+ENGINE_CONTRACT = 5  # 5: partition windows feed the perfect failure
+# detector (dynamic quorum masks avoid cross-cut peers; engine/faults.py)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -154,6 +156,13 @@ class SimSpec:
     # hard simulated-time stop (ms): bounds runs that a fault schedule
     # stalls on purpose (> f crashes must stall, not spin to max_steps)
     deadline_ms: Optional[int] = None
+    # device-resident windowed trace recorder (obs/trace.py TraceSpec):
+    # fixed-shape per-window counter tensors ride in SimState.trace and are
+    # binned inside the jitted step — zero extra host round-trips, so every
+    # driver (run / run_chunk / run_megachunk, donated or not, vmapped)
+    # works unchanged. None compiles the exact pre-trace program: the trace
+    # leaf is None (an empty pytree node) and every hook is Python-gated.
+    trace: Optional[Any] = None
 
     @property
     def dots(self) -> int:
@@ -276,6 +285,10 @@ class SimState(NamedTuple):
     # plugged-in state
     proto: Any
     exec: Any
+    # per-window trace tensors (obs/trace.py; dict pytree when
+    # SimSpec.trace is set, None otherwise — None is an EMPTY pytree node,
+    # so disabled builds carry zero extra leaves)
+    trace: Any = None
 
 
 class Candidates(NamedTuple):
@@ -413,6 +426,12 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
     NR = max(spec.batch_max_size, 1)  # latency records per client reply
     exdef = pdef.executor
     consts = workload_mod.WorkloadConsts.build(wl)
+    TR = spec.trace  # TraceSpec or None (obs/trace.py)
+
+    def _tr_has(st: "SimState", name: str) -> bool:
+        """Is trace channel `name` compiled into this state? (Python-level:
+        st.trace is a dict whose keys are fixed at trace time.)"""
+        return TR is not None and st.trace is not None and name in st.trace
 
     # periodic interval table (static)
     intervals = list(spec.proto_periodic_ms)
@@ -581,7 +600,15 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         payload = jnp.sum(
             jnp.where(A[:, :, None], cand.payload[None, :, :], 0), axis=1
         )
+        tr = st.trace
+        if _tr_has(st, "insert"):
+            # the single pool-insert choke point: every message of the run
+            # passes through here — bin accepted inserts by arrival time
+            tr = {**tr, "insert": trace_mod.wadd_flat(
+                tr["insert"], TR.window_of(time), okc
+            )}
         return st._replace(
+            trace=tr,
             m_valid=st.m_valid | hit,
             m_time=put(st.m_time, time),
             m_seq=put(st.m_seq, seq_vals),
@@ -1298,6 +1325,11 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             m_valid=st.m_valid & ~(ohp.any(axis=0) | ohc.any(axis=0)),
             step=st.step + has_p.sum() + has_c.sum(),
         )
+        if _tr_has(st, "deliver"):
+            w = TR.window_of(jnp.full((n,), st.now, jnp.int32))
+            st = st._replace(trace={**st.trace, "deliver": trace_mod.wadd_rows(
+                st.trace["deliver"], w, has_p.astype(jnp.int32)
+            )})
 
         st, gdot, ok = _register_submits(st, has_p, kind_p, payload_p)
 
@@ -1917,6 +1949,11 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             m_valid=st.m_valid & ~pop_s,
             step=st.step + has_p.sum() + has_c.sum() + act_tmr.sum(),
         )
+        if _tr_has(st, "deliver"):
+            st = st._replace(trace={**st.trace, "deliver": trace_mod.wadd_rows(
+                st.trace["deliver"], TR.window_of(T[:n]),
+                act_real[:n].astype(jnp.int32),
+            )})
         now_p = T[:n]
         now_c = T[n:]
 
@@ -2059,6 +2096,14 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
                 m_valid=st.m_valid & ~fold_clear,
                 step=st.step + consumed.sum(),
             )
+            if _tr_has(st, "deliver"):
+                dl = st.trace["deliver"]
+                for j in range(KF):
+                    dl = trace_mod.wadd_rows(
+                        dl, TR.window_of(fk_t[:, j]),
+                        consumed[:, j].astype(jnp.int32),
+                    )
+                st = st._replace(trace={**st.trace, "deliver": dl})
         if NT > 0:
             koh = (
                 jnp.arange(NPER, dtype=jnp.int32)[None, :] == kstar[:, None]
@@ -2245,6 +2290,36 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
                 m_valid=st.m_valid.at[:C].set(st.m_valid[:C] & ~lost0),
                 faulted=st.faulted + lost0.sum(),
             )
+        if TR is not None:
+            tr0 = trace_mod.init_trace(
+                TR, n, spec.n_client_groups, st.proto, st.exec
+            )
+            if "issued" in tr0 and not OPEN:
+                # closed-loop clients issue command 1 at t=0 inside
+                # init_state (c_issued starts at 1), before any trip's
+                # counter diff can see it — seed window 0 so the channel
+                # total equals the run's issued counts
+                tr0["issued"] = trace_mod.wadd_groups(
+                    tr0["issued"], jnp.zeros((C,), jnp.int32),
+                    env.client_group, st.c_issued,
+                )
+            if "insert" in tr0:
+                # likewise, the initial submits/ticks occupy pool slots
+                # 0..C-1 without passing through _insert
+                tr0["insert"] = trace_mod.wadd_flat(
+                    tr0["insert"], TR.window_of(st.m_time[:C]),
+                    st.m_valid[:C],
+                )
+            if "crashed" in tr0 and env.crash_at is not None:
+                # the crash schedule is static Env data: fill the channel
+                # exactly at init (window w is 1 iff its [w*wm, (w+1)*wm)
+                # span intersects the process's crash window) instead of
+                # sampling at trip instants, which would leave 0s in
+                # windows no trip happens to start in
+                tr0["crashed"] = trace_mod.crashed_windows(
+                    TR, env.crash_at, env.recover_at
+                )
+            st = st._replace(trace=tr0)
         return st
 
     def cond(st: SimState):
@@ -2336,8 +2411,47 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         wl_tabs = _wl_tables(env)
         if FAST:
             aux = _fast_aux(env)
-            return functools.partial(_fast_round, env, aux, wl_tabs)
-        return functools.partial(body, env, wl_tabs)
+            fn = functools.partial(_fast_round, env, aux, wl_tabs)
+        else:
+            fn = functools.partial(body, env, wl_tabs)
+        if TR is None:
+            return fn
+
+        def traced(st: SimState) -> SimState:
+            # counter-diff recording around the trip: the protocol/executor
+            # states already keep monotone cumulative counters (commit/
+            # fast/slow/execute) and the engine keeps submit/issued/done
+            # cumulatives (next_seq/c_issued/lat_cnt); the per-trip delta
+            # bins at the instant each row acted — the post-trip local
+            # clocks under the lookahead discipline (rows act at their own
+            # component instants), the pre-trip global `now` under the
+            # exact loop. Non-acting rows have delta 0, so stale instants
+            # never contribute.
+            pre = trace_mod.counter_snapshot(
+                st.trace, st.proto, st.exec, st.next_seq, st.c_issued,
+                st.lat_cnt,
+            )
+            t0 = st.now
+            st2 = fn(st)
+            if FAST:
+                t_proc, t_cli = st2.lc[:n], st2.lc[n:]
+            else:
+                t_proc = jnp.full((n,), t0, jnp.int32)
+                t_cli = jnp.full((C,), t0, jnp.int32)
+            ts = trace_mod.record_counter_deltas(
+                TR, st2.trace, pre, st2.proto, st2.exec, st2.next_seq,
+                st2.c_issued, st2.lat_cnt, t_proc, t_cli, env.client_group,
+            )
+            if "pool_hw" in ts:
+                ts["pool_hw"] = trace_mod.wmax_scalar(
+                    ts["pool_hw"], TR.window_of(t0),
+                    st2.m_valid.sum(),
+                )
+            # (the crashed channel is filled exactly from the static
+            # schedule at init_state — no per-trip sampling needed)
+            return st2._replace(trace=ts)
+
+        return traced
 
     def run(env: Env) -> SimState:
         return jax.lax.while_loop(cond, _body_for(env), init_state(env))

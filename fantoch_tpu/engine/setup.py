@@ -47,6 +47,7 @@ def build_spec(
     faults: bool = False,
     faults_dup: bool = False,
     deadline_ms: Optional[int] = None,
+    trace=None,
 ) -> SimSpec:
     if batch_max_size > 1:
         assert open_loop_interval_ms is not None, (
@@ -158,6 +159,9 @@ def build_spec(
         faults=faults,
         faults_dup=faults_dup,
         deadline_ms=deadline_ms,
+        # windowed trace recorder (obs/trace.py TraceSpec; None = off, the
+        # identical pre-trace program)
+        trace=trace,
     )
 
 

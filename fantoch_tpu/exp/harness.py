@@ -246,7 +246,7 @@ def _bucket_key(pt: Point) -> Tuple:
     )
 
 
-def _engine_fingerprint(pt0, C: int) -> Dict[str, int]:
+def _engine_fingerprint(pt0, C: int, trace=None) -> Dict[str, Any]:
     """Engine parameters derived from CODE rather than the grid — recorded
     in each bucket's meta and compared on resume, so a policy change (e.g.
     the ring-window floor) forces a re-run instead of silently mixing
@@ -276,6 +276,17 @@ def _engine_fingerprint(pt0, C: int) -> Dict[str, int]:
         "exact": 1 if os.environ.get("FANTOCH_EXACT") else 0,
         "row_loop": os.environ.get("FANTOCH_ROW_LOOP", ""),
         "fold": os.environ.get("FANTOCH_FOLD", "1"),
+        # the trace spec is part of the compiled program AND adds result
+        # arrays: a trace-enabled sweep must not resume from (or be
+        # resumed by) a trace-off results dir — nor from one recorded with
+        # a different channel set (the channels decide which trace_<name>
+        # arrays exist in data.npz)
+        "trace": (
+            f"{trace.window_ms}x{trace.max_windows}:"
+            + ",".join(trace.channels)
+            if trace is not None
+            else ""
+        ),
     }
 
 
@@ -298,6 +309,7 @@ def run_grid(
     pool_slots: Optional[int] = None,
     resume: bool = False,
     stats: Optional[Dict[str, int]] = None,
+    trace=None,
 ) -> List[str]:
     """Run every grid point and persist one results dir per shape bucket.
 
@@ -310,7 +322,18 @@ def run_grid(
     in-flight aggregate metrics per executed chunk — the periodic
     metrics-snapshot file of the reference's `metrics_logger_task`
     (`fantoch/src/run/task/server/metrics_logger.rs`, wiring
-    `run/mod.rs:333-351`).
+    `run/mod.rs:333-351`). LEGACY: it forces the host-driven chunk loop
+    (one full-state pull per chunk), forfeiting the megachunk driver's
+    host-sync reduction; prefer `trace`.
+
+    `trace` (an `obs.trace.TraceSpec`) compiles the device-resident
+    windowed trace recorder into every bucket's program: per-window
+    counter tensors ride in SimState and are binned inside the jitted
+    step, so it composes with the megachunk driver, donation and the
+    mesh — zero additional host syncs. The per-config trace arrays land in
+    each bucket's data.npz as `trace_<channel>` (plot/db.py) and a
+    rendered timeline report (trace.json + trace.md, obs/report.py) is
+    written next to it.
 
     Returns the created directories (load them with `ResultsDB.load` on the
     parent root)."""
@@ -354,7 +377,7 @@ def run_grid(
                     )
                     if meta.get("searches") == want and meta.get(
                         "engine_params"
-                    ) == _engine_fingerprint(bpoints[0], C_b):
+                    ) == _engine_fingerprint(bpoints[0], C_b, trace):
                         done_dirs.append(d)
                 except (OSError, ValueError):
                     continue
@@ -380,7 +403,7 @@ def run_grid(
         # per-dot state (and the graph executor's closure) stays sized by
         # the in-flight window; submits defer (never drop) under pressure.
         # FPaxos/Caesar run unwindowed (static dot space).
-        max_seq = _engine_fingerprint(pt0, C)["max_seq"]
+        max_seq = _engine_fingerprint(pt0, C, trace)["max_seq"]
         pdef = make_protocol_def(
             pt0.protocol,
             n,
@@ -441,6 +464,7 @@ def run_grid(
                     faults=pt0.fault_schedule() is not None,
                     faults_dup=pt0.dup_pct > 0,
                     deadline_ms=pt0.deadline_ms or None,
+                    trace=trace,
                 )
             envs.append(
                 setup.build_env(
@@ -529,6 +553,9 @@ def run_grid(
                 for k, v in summary.executor_metrics(st, pdef).items()
             }
         )
+        trace_arrays = None
+        if trace is not None and st.trace is not None:
+            trace_arrays = {k: np.asarray(v) for k, v in st.trace.items()}
         out_dirs.append(
             results_db.save_sweep(
                 results_root,
@@ -543,16 +570,117 @@ def run_grid(
                 steps=np.asarray(st.step),
                 client_regions=client_regions,
                 metrics=metrics,
+                trace=trace_arrays,
                 extra_meta={
                     "process_regions": list(pregions),
                     "dstat": dstat,
-                    "engine_params": _engine_fingerprint(pt0, C),
+                    "engine_params": _engine_fingerprint(pt0, C, trace),
                 },
             )
         )
+        if trace_arrays is not None:
+            _write_trace_reports(out_dirs[-1], st, trace, searches,
+                                 client_regions)
         if verbose:
             print(f"bucket {bi} ({bkey}) -> {out_dirs[-1]}", flush=True)
     return out_dirs
+
+
+def _write_trace_reports(out_dir: str, st, tspec, searches,
+                         client_regions) -> None:
+    """Render one timeline report per config of a finished bucket into the
+    results dir: trace.json (one report object per config, with its search
+    keys) + trace.md (human timelines, obs/report.py)."""
+    import json
+
+    from ..obs import report as obs_report
+
+    reports = []
+    md = []
+    for b, search in enumerate(searches):
+        cfg = jax.tree_util.tree_map(lambda x, b=b: x[b], st)
+        rep = obs_report.drain(cfg, tspec, client_regions=client_regions)
+        reports.append({"search": search, "report": rep})
+        label = " ".join(
+            f"{k}={search[k]}"
+            for k in ("protocol", "n", "f", "clients", "conflict")
+            if k in search
+        )
+        md.append(obs_report.render_markdown(rep, title=f"trace — {label}"))
+    with open(os.path.join(out_dir, "trace.json"), "w") as f:
+        json.dump(reports, f)
+    with open(os.path.join(out_dir, "trace.md"), "w") as f:
+        f.write("\n".join(md))
+
+
+def run_point_traced(
+    pt: Point,
+    tspec,
+    *,
+    planet: Optional[Planet] = None,
+    process_regions: Optional[Sequence[str]] = None,
+    client_regions: Optional[Sequence[str]] = None,
+    gc_interval_ms: int = 50,
+    extra_ms: int = 2000,
+    max_steps: int = 50_000_000,
+):
+    """Run ONE grid point with the trace recorder compiled in and return
+    `(state, spec, env, client_regions)` — the raw material of the CLI
+    `trace` subcommand and the trace tests (run_grid persists results but
+    discards the state the trace tensors live in)."""
+    from ..engine import lockstep
+
+    planet = planet or Planet.new()
+    client_regions = list(client_regions or ["us-west1", "us-west2"])
+    n = pt.n
+    pregions = list(process_regions or [])
+    if not pregions:
+        pregions = [r for r in planet.regions()][:n]
+    pregions = pregions[:n]
+    C = len(client_regions) * pt.clients_per_region
+    wl = pt.workload()
+    max_seq = _engine_fingerprint(pt, C, tspec)["max_seq"]
+    pdef = make_protocol_def(
+        pt.protocol,
+        n,
+        setup.command_key_slots(wl, pt.batch_max_size),
+        max_seq=max_seq,
+        key_space_hint=wl.key_space(C),
+        nfr=pt.nfr,
+        wait_condition=pt.caesar_wait_condition,
+        skip_fast_ack=pt.skip_fast_ack,
+        execute_at_commit=pt.execute_at_commit,
+    )
+    leader = 1 if not pdef.leaderless else None
+    config = Config(
+        n=n, f=pt.f, gc_interval_ms=gc_interval_ms, leader=leader,
+        leader_check_interval_ms=pt.leader_check_interval_ms or None,
+        nfr=pt.nfr,
+        skip_fast_ack=pt.skip_fast_ack,
+        execute_at_commit=pt.execute_at_commit,
+        caesar_wait_condition=pt.caesar_wait_condition,
+    )
+    spec = setup.build_spec(
+        config, wl, pdef, n_clients=C, n_client_groups=len(client_regions),
+        max_seq=max_seq, extra_ms=extra_ms, max_steps=max_steps,
+        open_loop_interval_ms=pt.open_loop_interval_ms or None,
+        batch_max_size=pt.batch_max_size,
+        batch_max_delay_ms=pt.batch_max_delay_ms,
+        faults=pt.fault_schedule() is not None,
+        faults_dup=pt.dup_pct > 0,
+        deadline_ms=pt.deadline_ms or None,
+        trace=tspec,
+    )
+    placement = setup.Placement(pregions, client_regions,
+                                pt.clients_per_region)
+    env = setup.build_env(spec, config, planet, placement, wl, pdef,
+                          seed=pt.seed, faults=pt.fault_schedule())
+    st = jax.jit(lockstep.make_run(spec, pdef, wl))(env)
+    st = jax.tree_util.tree_map(np.asarray, st)
+    summary.check_sim_health(
+        st, allow_stall=pt.fault_schedule() is not None
+    )
+    return st, spec, env, client_regions
 
 
 def _append_metrics_snapshot(path: str, bucket: int, st, pdef) -> None:

@@ -1,0 +1,9 @@
+"""Device-resident observability: windowed trace recording + host reports.
+
+`obs.trace` holds the static `TraceSpec` and the traceable window-binning
+helpers the engines call *inside* their jitted step functions; `obs.report`
+drains a finished `SimState` into per-window time series, derived views
+(rates, fast-path ratio, stall detection) and JSON/Markdown reports.
+"""
+from . import report, trace  # noqa: F401
+from .trace import TraceSpec  # noqa: F401

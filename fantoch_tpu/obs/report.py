@@ -1,0 +1,183 @@
+"""Host-side drain of device trace tensors into timeline reports.
+
+Turns a finished `SimState` (single config) carrying `trace` tensors
+(obs/trace.py) into per-window time series plus derived views:
+
+- per-region throughput / issue / completion rates (cmds per second),
+- the fast-path ratio timeline (`fast / (fast + slow)` per window),
+- a stall detector generalizing `summary.recovery_stats`'s `max_gap_ms` to
+  EVERY channel: the longest silent stretch of windows between activity,
+  which is how a crash dip (silence) and the failover recovery edge (the
+  first active window after it) show up in a fault run's timeline.
+
+Rendered as JSON (machine) and Markdown (human, with sparkline rows).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from .trace import PER_GROUP, TraceSpec
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def stall_stats(per_window: Sequence[float], window_ms: int) -> Dict[str, Any]:
+    """Longest silence in a per-window activity series.
+
+    Generalizes `recovery_stats.max_gap_ms` (the gap between consecutive
+    completions, measured from t=0) to any channel, at window resolution:
+    the gap before the first active window counts (silence from t=0), gaps
+    after the last active window do not (the run simply ended)."""
+    arr = np.asarray(per_window)
+    active = np.nonzero(arr > 0)[0]
+    if len(active) == 0:
+        return {"max_gap_ms": 0.0, "gap_start_ms": 0.0, "gap_end_ms": 0.0}
+    # activity instants at window granularity; include the t=0 anchor like
+    # recovery_stats' leading gap
+    marks = np.concatenate([[-1], active])
+    gaps = np.diff(marks)  # in windows; leading gap = first_active + 1
+    i = int(np.argmax(gaps))
+    return {
+        "max_gap_ms": float(gaps[i] * window_ms),
+        "gap_start_ms": float((marks[i] + 1) * window_ms),
+        "gap_end_ms": float((marks[i + 1] + 1) * window_ms),
+    }
+
+
+def drain(
+    st,
+    tspec: TraceSpec,
+    client_regions: Optional[Sequence[str]] = None,
+) -> Dict[str, Any]:
+    """Per-window series + derived views of one finished config's trace.
+
+    `st` is a finished SimState (or any object with `.trace`/`.now`); pass
+    `client_regions` to label the per-group channels by region name."""
+    tr = getattr(st, "trace", None)
+    if tr is None:
+        raise ValueError(
+            "state carries no trace tensors — run with SimSpec.trace set"
+        )
+    arrays = {k: np.asarray(v) for k, v in tr.items()}
+    W, wm = tspec.max_windows, tspec.window_ms
+    # the loop may leave `now` at INF_TIME (clock advanced past the last
+    # event): the run's horizon is bounded by final_time (last completion
+    # + drain window) whenever that is set
+    _INF = int(2**30)
+    horizon = int(np.asarray(st.now))
+    final = int(np.asarray(getattr(st, "final_time", _INF)))
+    if horizon >= _INF:
+        horizon = final if final < _INF else W * wm
+    used = max(1, min(W, horizon // wm + 1))
+
+    channels: Dict[str, Any] = {}
+    for name, arr in sorted(arrays.items()):
+        # window-leading layout only (the lockstep engine's). The quantum
+        # runner's per-DEVICE tensors ([n, W, ...]) would reshape without
+        # error but scramble the series — refuse them instead.
+        assert arr.shape[0] == W, (
+            f"trace[{name}] is {arr.shape}, expected a window-leading"
+            f" [{W}, ...] array — quantum-runner traces are per-device"
+            " [n, W, ...]; transpose/aggregate them before drain()"
+        )
+        per_window = (
+            arr if arr.ndim == 1 else arr.reshape(W, -1).sum(axis=1)
+        )[:used]
+        rec: Dict[str, Any] = {
+            "total": int(per_window.sum()),
+            "per_window": [int(x) for x in per_window],
+            "stall": stall_stats(per_window, wm),
+        }
+        if name == "pool_hw":
+            rec["total"] = int(arr.max())  # a gauge: max, not a sum
+        if arr.ndim == 2 and name in PER_GROUP and client_regions:
+            rec["per_region"] = {
+                region: [int(x) for x in arr[:used, g]]
+                for g, region in enumerate(client_regions)
+                if g < arr.shape[1]
+            }
+        channels[name] = rec
+
+    report: Dict[str, Any] = {
+        "window_ms": wm,
+        "max_windows": W,
+        "windows_used": used,
+        "horizon_ms": horizon,
+        "truncated": horizon >= W * wm,
+        "channels": channels,
+    }
+
+    # derived: per-region completion rate (cmds/s) from the done channel
+    if "done" in arrays and client_regions:
+        done = arrays["done"]
+        report["rates_per_sec"] = {
+            region: [
+                round(float(x) * 1000.0 / wm, 3) for x in done[:used, g]
+            ]
+            for g, region in enumerate(client_regions)
+            if g < done.shape[1]
+        }
+    # derived: fast-path ratio timeline
+    if "fast" in arrays and "slow" in arrays:
+        fast = arrays["fast"].sum(axis=1)[:used]
+        slow = arrays["slow"].sum(axis=1)[:used]
+        tot = fast + slow
+        report["fast_path_ratio"] = [
+            round(float(f) / t, 4) if t else None
+            for f, t in zip(fast, tot)
+        ]
+    return report
+
+
+def spark(per_window: Sequence[float]) -> str:
+    """Unicode sparkline of one per-window series."""
+    arr = np.asarray(per_window, dtype=float)
+    if arr.size == 0:
+        return ""
+    top = arr.max()
+    if top <= 0:
+        return "·" * len(arr)
+    idx = np.minimum(
+        (arr / top * (len(_SPARK) - 1)).round().astype(int), len(_SPARK) - 1
+    )
+    return "".join("·" if v <= 0 else _SPARK[i] for v, i in zip(arr, idx))
+
+
+def render_json(report: Dict[str, Any]) -> str:
+    return json.dumps(report)
+
+
+def render_markdown(report: Dict[str, Any], title: str = "trace") -> str:
+    wm = report["window_ms"]
+    used = report["windows_used"]
+    lines = [
+        f"# {title}",
+        "",
+        f"- window: {wm} ms × {used} used"
+        f" (of {report['max_windows']}; horizon {report['horizon_ms']} ms"
+        + (", **truncated**" if report["truncated"] else "")
+        + ")",
+        "",
+        "| channel | total | max gap (ms) | timeline |",
+        "|---|---:|---:|---|",
+    ]
+    for name, rec in report["channels"].items():
+        lines.append(
+            f"| {name} | {rec['total']} | "
+            f"{rec['stall']['max_gap_ms']:.0f} | "
+            f"`{spark(rec['per_window'])}` |"
+        )
+    if "fast_path_ratio" in report:
+        ratio = [0.0 if r is None else r for r in report["fast_path_ratio"]]
+        lines += [
+            "",
+            f"fast-path ratio: `{spark(ratio)}`",
+        ]
+    if "rates_per_sec" in report:
+        lines.append("")
+        for region, series in report["rates_per_sec"].items():
+            lines.append(f"- {region}: `{spark(series)}` cmds/s per window")
+    return "\n".join(lines) + "\n"

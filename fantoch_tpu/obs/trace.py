@@ -1,0 +1,246 @@
+"""Device-resident windowed trace recorder.
+
+The in-flight observability the reference gets from `metrics_logger_task`
+(`fantoch/src/run/task/server/metrics_logger.rs` — a periodic host task
+snapshotting per-process metrics to a file) re-designed for the device
+engines: a static `TraceSpec` compiles fixed-shape per-window counter
+tensors *into* `SimState`, and the engines bin events into them inside the
+jitted step function — zero host round-trips, so a trace-enabled run keeps
+the megachunk driver's O(chunks/k) host-sync count, donation, and the
+vmapped sweep (the host-loop `--metrics-log` snapshot path is the legacy
+alternative). A disabled spec (`SimSpec.trace is None`) adds NOTHING: the
+trace leaf is `None` (an empty pytree node) and every hook is gated by a
+Python-level `if`, so the compiled program is bit-identical to a pre-trace
+build.
+
+Channels (each a per-window int32 tensor; `n` processes, `G` client
+histogram groups, `W = max_windows`):
+
+=========== ======== ====================================================
+channel     shape    meaning (per window)
+=========== ======== ====================================================
+submit      [W, n]   commands registered per coordinator (dot allocation)
+deliver     [W, n]   pool messages handled per process
+insert      [W]      pool insertions, binned by arrival time
+commit      [W, n]   protocol commits (diff of `commit_count`)
+fast        [W, n]   fast-path takes (diff of `fast_count`)
+slow        [W, n]   slow-path takes (diff of `slow_count`)
+execute     [W, n]   commands executed (diff of `executed_count`)
+issued      [W, G]   client commands issued per region group
+done        [W, G]   client commands completed per region group
+pool_hw     [W]      pool-occupancy high water (max over the window)
+crashed     [W, n]   0/1: window span intersects the process's crash
+                     window (filled exactly from the schedule at init)
+=========== ======== ====================================================
+
+The counter channels (`commit`/`fast`/`slow`/`execute`) are recorded by
+DIFFING the protocol/executor state's own monotone counters around each
+engine trip and binning the delta at the instant the row acted — no
+protocol code changes, and any protocol that exposes the counter gets the
+channel for free (ones that lack it simply omit the tensor; the report
+shows the channel as absent). Event channels (`submit`/`deliver`/`insert`)
+hook the engine's own choke points. Everything is expressed as the dense
+one-hot broadcast ops the rest of the engine uses (`ops/dense.py`
+rationale: per-element scatters serialize on TPU; masked broadcasts
+vectorize over the config batch).
+
+Windows past `max_windows` clip into the last window (the report flags the
+truncation); pick `window_ms * max_windows` >= the simulated horizon.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+
+from ..ops import dense
+
+# channel name -> shape family
+PER_PROC_COUNTERS = ("commit", "fast", "slow", "execute")
+PER_PROC_EVENTS = ("submit", "deliver", "crashed")
+PER_GROUP = ("issued", "done")
+GLOBAL = ("insert", "pool_hw")
+CHANNELS: Tuple[str, ...] = (
+    "submit", "deliver", "insert", "commit", "fast", "slow", "execute",
+    "issued", "done", "pool_hw", "crashed",
+)
+
+# protocol/executor state leaves backing the diffed counter channels
+COUNTER_LEAVES = {
+    "commit": ("proto", "commit_count"),
+    "fast": ("proto", "fast_count"),
+    "slow": ("proto", "slow_count"),
+    "execute": ("exec", "executed_count"),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceSpec:
+    """Static trace parameters — part of `SimSpec`, hence of the compile
+    identity (hashable; changing any field is a different program)."""
+
+    window_ms: int = 100
+    max_windows: int = 64
+    channels: Tuple[str, ...] = CHANNELS
+
+    def __post_init__(self):
+        assert self.window_ms >= 1, "window_ms must be >= 1"
+        assert self.max_windows >= 1, "max_windows must be >= 1"
+        unknown = set(self.channels) - set(CHANNELS)
+        assert not unknown, f"unknown trace channels {sorted(unknown)}"
+
+    def window_of(self, t) -> jnp.ndarray:
+        """Window index of instant(s) `t` (clipped into the last window)."""
+        return jnp.clip(
+            jnp.asarray(t, jnp.int32) // jnp.int32(self.window_ms),
+            0,
+            self.max_windows - 1,
+        )
+
+    @property
+    def horizon_ms(self) -> int:
+        return self.window_ms * self.max_windows
+
+
+def _counter_leaf(st_proto: Any, st_exec: Any, name: str):
+    """The cumulative [n] counter backing channel `name`, or None when the
+    plugged-in state does not expose it (the same test `init_trace` uses,
+    so allocation and recording always agree)."""
+    holder, leaf = COUNTER_LEAVES[name]
+    return getattr(st_proto if holder == "proto" else st_exec, leaf, None)
+
+
+def init_trace(
+    tspec: TraceSpec, n: int, G: int, st_proto: Any, st_exec: Any
+) -> Dict[str, jnp.ndarray]:
+    """Fresh per-window tensors for the enabled channels (dict pytree —
+    rides in `SimState.trace`). Counter channels whose backing leaf the
+    protocol/executor lacks are omitted rather than carried as dead
+    zeros."""
+    W = tspec.max_windows
+    out: Dict[str, jnp.ndarray] = {}
+    for name in tspec.channels:
+        if name in COUNTER_LEAVES and _counter_leaf(st_proto, st_exec, name) is None:
+            continue
+        if name in PER_GROUP:
+            shape = (W, G)
+        elif name in GLOBAL:
+            shape = (W,)
+        else:
+            shape = (W, n)
+        out[name] = jnp.zeros(shape, jnp.int32)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# traceable window-binning primitives (dense one-hot, no scatters)
+# ---------------------------------------------------------------------------
+
+
+def wadd_rows(arr: jnp.ndarray, w: jnp.ndarray, delta: jnp.ndarray):
+    """`arr[w[j], j] += delta[j]` for a [W, n] channel ([n] windows/deltas)."""
+    W = arr.shape[0]
+    ohw = dense.oh(w, W)  # [n, W]
+    return arr + (ohw.astype(jnp.int32) * delta.astype(jnp.int32)[:, None]).T
+
+
+def wadd_flat(arr: jnp.ndarray, w: jnp.ndarray, delta: jnp.ndarray):
+    """`arr[w[j]] += delta[j]` for a [W] channel ([CN] windows/deltas)."""
+    W = arr.shape[0]
+    ohw = dense.oh(w, W)  # [CN, W]
+    return arr + jnp.sum(
+        ohw.astype(jnp.int32) * delta.astype(jnp.int32)[:, None], axis=0
+    )
+
+
+def wmax_scalar(arr: jnp.ndarray, w, val):
+    """`arr[w] = max(arr[w], val)` for a [W] channel (scalar w/val)."""
+    W = arr.shape[0]
+    mask = dense.oh(jnp.asarray(w, jnp.int32), W)  # [W]
+    return jnp.where(mask, jnp.maximum(arr, jnp.asarray(val, jnp.int32)), arr)
+
+
+def wadd_groups(arr: jnp.ndarray, w: jnp.ndarray, g: jnp.ndarray,
+                delta: jnp.ndarray):
+    """`arr[w[c], g[c]] += delta[c]` for a [W, G] channel ([C] rows)."""
+    W, G = arr.shape
+    ohw = dense.oh(w, W)  # [C, W]
+    ohg = dense.oh(g, G)  # [C, G]
+    return arr + jnp.einsum(
+        "cw,cg,c->wg",
+        ohw.astype(jnp.int32),
+        ohg.astype(jnp.int32),
+        delta.astype(jnp.int32),
+    )
+
+
+def crashed_windows(tspec: TraceSpec, crash_at, recover_at) -> jnp.ndarray:
+    """[W, n] exact crashed channel from the static schedule: window w is
+    1 for process p iff w's `[w*window_ms, (w+1)*window_ms)` span
+    intersects p's `[crash_at, recover_at)` window. Computed once at
+    init_state (the schedule is Env data), so no per-trip sampling and no
+    holes in windows without engine trips."""
+    W = tspec.max_windows
+    wstart = jnp.arange(W, dtype=jnp.int32) * jnp.int32(tspec.window_ms)
+    wend = wstart + jnp.int32(tspec.window_ms)
+    hit = (wstart[:, None] < jnp.asarray(recover_at)[None, :]) & (
+        wend[:, None] > jnp.asarray(crash_at)[None, :]
+    )
+    return hit.astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# counter-diff recording (the lockstep engine's per-trip discipline; the
+# quantum runner re-states the same snapshot/diff/bin steps per device with
+# scalar windows and its own channel subset — parallel/quantum.py
+# quantum_step — because its tensors carry a per-device leading axis and
+# its deliver channel diffs the runner's step counter)
+# ---------------------------------------------------------------------------
+
+
+def counter_snapshot(
+    trace: Dict[str, jnp.ndarray], st_proto: Any, st_exec: Any,
+    next_seq: jnp.ndarray, c_issued: jnp.ndarray, lat_cnt: jnp.ndarray,
+) -> Dict[str, jnp.ndarray]:
+    """Cumulative counters backing the diffed channels, captured BEFORE an
+    engine trip. `next_seq`/`c_issued`/`lat_cnt` are the engine's own
+    monotone cumulatives for submit/issued/done."""
+    pre: Dict[str, jnp.ndarray] = {}
+    if "submit" in trace:
+        pre["submit"] = next_seq
+    if "issued" in trace:
+        pre["issued"] = c_issued
+    if "done" in trace:
+        pre["done"] = lat_cnt
+    for name in COUNTER_LEAVES:
+        if name in trace:
+            pre[name] = _counter_leaf(st_proto, st_exec, name)
+    return pre
+
+
+def record_counter_deltas(
+    tspec: TraceSpec,
+    trace: Dict[str, jnp.ndarray],
+    pre: Dict[str, jnp.ndarray],
+    st_proto: Any, st_exec: Any,
+    next_seq: jnp.ndarray, c_issued: jnp.ndarray, lat_cnt: jnp.ndarray,
+    t_proc: jnp.ndarray,  # [n] per-process attribution instants
+    t_cli: jnp.ndarray,  # [C] per-client attribution instants
+    client_group: jnp.ndarray,  # [C]
+) -> Dict[str, jnp.ndarray]:
+    """Bin this trip's counter increments at the instants the rows acted.
+    Rows that did not act have delta 0, so their (possibly stale) instants
+    never contribute."""
+    cur = counter_snapshot(trace, st_proto, st_exec, next_seq, c_issued,
+                           lat_cnt)
+    ts = dict(trace)
+    w_proc = tspec.window_of(t_proc)
+    w_cli = tspec.window_of(t_cli)
+    for name, now_v in cur.items():
+        delta = now_v - pre[name]
+        if name in PER_GROUP:
+            ts[name] = wadd_groups(ts[name], w_cli, client_group, delta)
+        else:
+            ts[name] = wadd_rows(ts[name], w_proc, delta)
+    return ts

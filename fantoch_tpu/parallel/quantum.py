@@ -64,6 +64,7 @@ from ..core import workload as workload_mod
 from ..core import ids
 from ..engine import faults as faults_mod
 from ..engine.lockstep import Env, SimSpec, message_width
+from ..obs import trace as obs_trace
 from ..ops import dense
 from ..engine.types import (
     INF_TIME,
@@ -177,6 +178,13 @@ class RState(NamedTuple):
     # plugged-in pytrees, leading axis n
     proto: Any
     exec: Any
+    # per-device windowed trace tensors (obs/trace.py; dict pytree with a
+    # leading n axis when SimSpec.trace is set, None otherwise). The runner
+    # records the submit/deliver/insert/commit/issued/done/crashed subset:
+    # events bin at each quantum's instant, arrivals at the exchange (send)
+    # boundary, crashed exactly from the static schedule at init.
+    # Disabled = zero extra leaves, the identical program.
+    trace: Any = None
 
 
 class Local(NamedTuple):
@@ -228,6 +236,7 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
     G = spec.n_client_groups
     exdef = pdef.executor
     consts = workload_mod.WorkloadConsts.build(wl)
+    TR = spec.trace  # TraceSpec or None (obs/trace.py)
     IP = inbox_slots or max(256, 2 * S // max(n, 1))
     # worst-case send rows appended per handled event to one dst column
     WC = pdef.max_out + 2 + spec.max_res
@@ -374,6 +383,44 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             ipay[p, s, 1] = 1  # rifl 1
             ipay[p, s, 2] = int(ro0[c])
             ipay[p, s, 3 : 3 + KPC] = keys0[c]
+        proto0 = pdef.init(spec, env)
+        trace0 = None
+        if TR is not None:
+            W_TR = TR.max_windows
+            ch = set(TR.channels)
+            trace0 = {}
+            for nm in ("submit", "deliver", "insert"):
+                if nm in ch:
+                    trace0[nm] = jnp.zeros((n, W_TR), jnp.int32)
+            if "commit" in ch and getattr(proto0, "commit_count", None) is not None:
+                trace0["commit"] = jnp.zeros((n, W_TR), jnp.int32)
+            for nm in ("issued", "done"):
+                if nm in ch:
+                    trace0[nm] = jnp.zeros((n, W_TR, G), jnp.int32)
+            if "issued" in trace0 and not OPEN:
+                # closed-loop clients issue command 1 inside init_state:
+                # seed window 0 (the lockstep engine's convention)
+                seed_i = np.zeros((n, W_TR, G), np.int32)
+                for p in range(n):
+                    for s in range(CM):
+                        if cl_present[p, s]:
+                            seed_i[p, 0, int(cl_group[p, s])] += 1
+                trace0["issued"] = jnp.asarray(seed_i)
+            if "insert" in trace0:
+                # the initial inbox entries never cross the exchange
+                # boundary: seed their arrival windows
+                seed_n = np.zeros((n, W_TR), np.int32)
+                for p, s in zip(*np.nonzero(iv)):
+                    seed_n[p, min(int(it[p, s]) // TR.window_ms, W_TR - 1)] += 1
+                trace0["insert"] = jnp.asarray(seed_n)
+            if "crashed" in ch:
+                # exact from the static schedule — the same predicate as
+                # the lockstep engine, transposed to per-device layout
+                trace0["crashed"] = jnp.asarray(
+                    np.asarray(
+                        obs_trace.crashed_windows(TR, crash_np, rec_np)
+                    ).T
+                )
         return RState(
             now=jnp.int32(0),
             all_done=jnp.bool_(False),
@@ -409,8 +456,9 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             lat_cnt=jnp.zeros((n, CM), jnp.int32),
             hist=jnp.zeros((n, G, NB), jnp.int32),
             hist_overflow=jnp.zeros((n,), jnp.int32),
-            proto=pdef.init(spec, env),
+            proto=proto0,
             exec=exdef.init(spec, env),
+            trace=trace0,
         )
 
     # ------------- device-side helpers (local leading axis = 1) -------------
@@ -932,6 +980,13 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             i_payload=st.i_payload.at[0, tgt].set(spay.reshape(-1, W), mode="drop"),
             dropped=st.dropped.at[0].add((rv & ~ok).sum()),
         )
+        if TR is not None and st.trace is not None and "insert" in st.trace:
+            # the runner's send boundary: every exchanged message lands
+            # here — bin accepted arrivals by their delivery instant
+            ins0 = obs_trace.wadd_flat(
+                st.trace["insert"][0], TR.window_of(stime.reshape(-1)), ok
+            )
+            st = st._replace(trace={**st.trace, "insert": ins0[None]})
         return Local(st, *empty_send(), cont=L.cont)
 
     def subrounds(L: Local, myrow) -> Local:
@@ -1087,10 +1142,56 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             cont = cont & (t_next <= spec.deadline_ms)
         return L._replace(st=st, cont=cont)
 
+    def quantum_step(L: Local, myrow) -> Local:
+        """One quantum, plus (when tracing) counter-diff recording binned
+        at the quantum's instant — the lockstep engine's per-trip
+        discipline restated per device (each device is one row)."""
+        if TR is None:
+            return quantum(L, myrow)
+        st = L.st
+        pre_commit = getattr(st.proto, "commit_count", None)
+        pre = {
+            "submit": st.next_seq[0],
+            "deliver": st.step[0],
+            "commit": pre_commit[0] if pre_commit is not None else None,
+            "issued": st.c_issued[0],
+            "done": st.lat_cnt[0],
+        }
+        L2 = quantum(L, myrow)
+        st2 = L2.st
+        ts = dict(st2.trace)
+        w = TR.window_of(st2.now)  # the instant this quantum processed
+        ohw = dense.oh(w, TR.max_windows).astype(jnp.int32)  # [W]
+
+        def addw(name, cur):
+            ts[name] = ts[name] + (
+                ohw * jnp.asarray(cur - pre[name], jnp.int32)
+            )[None, :]
+
+        if "submit" in ts:
+            addw("submit", st2.next_seq[0])
+        if "deliver" in ts:
+            addw("deliver", st2.step[0])
+        if "commit" in ts and pre["commit"] is not None:
+            addw("commit", st2.proto.commit_count[0])
+        grp = lenv.cl_group[myrow]  # [CM]
+        wv = jnp.full((CM,), w, jnp.int32)
+        if "issued" in ts:
+            ts["issued"] = obs_trace.wadd_groups(
+                ts["issued"][0], wv, grp, st2.c_issued[0] - pre["issued"]
+            )[None]
+        if "done" in ts:
+            ts["done"] = obs_trace.wadd_groups(
+                ts["done"][0], wv, grp, st2.lat_cnt[0] - pre["done"]
+            )[None]
+        return L2._replace(st=st2._replace(trace=ts))
+
     def run_local(st_local):
         myrow = jax.lax.axis_index(AXIS)
         L = Local(st_local, *empty_send(), cont=jnp.bool_(True))
-        L = jax.lax.while_loop(lambda L: L.cont, lambda L: quantum(L, myrow), L)
+        L = jax.lax.while_loop(
+            lambda L: L.cont, lambda L: quantum_step(L, myrow), L
+        )
         # 0-d leaves (overflow counters) are device-local but leave shard_map
         # through a replicated P() out-spec: return their global sum so a
         # single-device overflow can't vanish into an arbitrary shard's copy

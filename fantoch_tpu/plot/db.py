@@ -16,7 +16,9 @@ On-disk layout (one directory per sweep invocation, like the reference's
 `data.npz` arrays: `hist` [B, G, NB] per-region latency buckets,
 `issued` [B, C], `client_group` [B, C], `sim_time_ms` [B], `steps` [B],
 plus one `metric_<name>` [B, n] array per protocol metric (fast/slow/commits/
-stable/...).
+stable/...), plus — for trace-enabled sweeps (obs/trace.py) — one
+`trace_<channel>` per-window array per enabled channel
+([B, W, n] / [B, W, G] / [B, W]).
 """
 from __future__ import annotations
 
@@ -42,6 +44,9 @@ class ExperimentData:
     sim_time_ms: int
     steps: int
     metrics: Dict[str, np.ndarray]  # per-process protocol metrics
+    # per-window trace arrays (channel -> [W, ...]; empty unless the sweep
+    # ran with a TraceSpec — obs/trace.py)
+    traces: Dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
 
     @property
     def throughput_cmds_per_sec(self) -> float:
@@ -71,6 +76,7 @@ def save_sweep(
     steps: np.ndarray,  # [B]
     client_regions: Sequence[str],
     metrics: Optional[Dict[str, np.ndarray]] = None,  # name -> [B, n]
+    trace: Optional[Dict[str, np.ndarray]] = None,  # channel -> [B, W, ...]
     extra_meta: Optional[Dict[str, Any]] = None,
 ) -> str:
     """Write one sweep's batched results; returns the created directory."""
@@ -97,6 +103,8 @@ def save_sweep(
     }
     for k, v in (metrics or {}).items():
         arrays[f"metric_{k}"] = np.asarray(v)
+    for k, v in (trace or {}).items():
+        arrays[f"trace_{k}"] = np.asarray(v)
     # atomic publish: a crash mid-write must not leave a truncated data.npz
     # that a resumed sweep (exp/harness.py run_grid resume=True) would
     # trust. The temp name must END in .npz — np.savez appends the suffix
@@ -137,6 +145,9 @@ class ResultsDB:
         metric_names = [
             k[len("metric_"):] for k in data.files if k.startswith("metric_")
         ]
+        trace_names = [
+            k[len("trace_"):] for k in data.files if k.startswith("trace_")
+        ]
         for b, search in enumerate(meta["searches"]):
             per_region: Dict[str, Histogram] = {}
             merged = Histogram()
@@ -154,6 +165,9 @@ class ResultsDB:
                     steps=int(data["steps"][b]),
                     metrics={
                         name: data[f"metric_{name}"][b] for name in metric_names
+                    },
+                    traces={
+                        name: data[f"trace_{name}"][b] for name in trace_names
                     },
                 )
             )

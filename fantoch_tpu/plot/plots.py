@@ -14,6 +14,9 @@ matplotlib — same families:
   (`nfr_plot`, lib.rs:282)
 - `recovery_plot`       — latency timelines around a failure, per site
   (`recovery_plot`, lib.rs:185)
+- `trace_timeline`      — per-window channel timelines from a device trace
+  report (obs/report.py), the in-run view `recovery_plot` reconstructs
+  post-hoc from completion times
 - `heatmap_plot`        — metric over a 2-D config grid (`heatmap_plot`)
 - `batching_plot`       — throughput/latency vs batch size (`batching_plot`)
 - `metrics_table`       — text table of per-process protocol/executor
@@ -302,6 +305,45 @@ def recovery_plot(
         ax.grid(alpha=0.3)
         ax.legend(fontsize=7)
     for j in range(len(sites), nrows * ncols):
+        axes[j // ncols][j % ncols].axis("off")
+    fig.savefig(output, bbox_inches="tight", dpi=150)
+    plt.close(fig)
+    return output
+
+
+def trace_timeline(
+    report: Dict[str, Any],
+    output: str,
+    channels: Optional[Sequence[str]] = None,
+) -> str:
+    """Per-window channel timelines of one trace report (obs/report.py
+    `drain` output) — one subplot per channel, x in simulated seconds.
+    The device-recorded sibling of `recovery_plot`: a crash shows as a dip
+    to zero in the activity channels, a failover as the recovery edge
+    where they resume."""
+    wm = report["window_ms"]
+    chans = report["channels"]
+    names = [c for c in (channels or sorted(chans)) if c in chans]
+    ncols = 2
+    nrows = (len(names) + ncols - 1) // ncols
+    fig, axes = plt.subplots(
+        nrows, ncols, figsize=(8, 2.2 * nrows), squeeze=False
+    )
+    fig.subplots_adjust(hspace=0.7, wspace=0.25)
+    for i, name in enumerate(names):
+        ax = axes[i // ncols][i % ncols]
+        ys = chans[name]["per_window"]
+        xs = (np.arange(len(ys)) + 0.5) * wm / 1000.0
+        ax.step(xs, ys, where="mid", linewidth=1)
+        ax.set_title(
+            f"{name} (total {chans[name]['total']}, "
+            f"max gap {chans[name]['stall']['max_gap_ms']:.0f} ms)",
+            fontsize=8,
+        )
+        ax.set_xlabel("time (s)", fontsize=7)
+        ax.grid(alpha=0.3)
+        ax.tick_params(labelsize=7)
+    for j in range(len(names), nrows * ncols):
         axes[j // ncols][j % ncols].axis("off")
     fig.savefig(output, bbox_inches="tight", dpi=150)
     plt.close(fig)

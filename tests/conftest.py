@@ -41,6 +41,12 @@ def pytest_configure(config):
         "markers",
         "heavy: redundant-coverage sweep, skipped unless FANTOCH_HEAVY=1",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 budgeted run (-m 'not slow'); the"
+        " heaviest oracle/lookahead parametrizations whose coverage the"
+        " remaining cases keep — run them with -m slow or no marker filter",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -67,6 +67,34 @@ def test_execution_log_replay_roundtrip():
         assert h == st.exec.order_hash[p, key], (p, h)
 
 
+def test_cli_trace_subcommand(capsys, tmp_path):
+    """Tier-1 trace smoke: the `trace` CLI runs one tiny config with the
+    device trace recorder and renders the windowed report (JSON + MD +
+    figure) — the CLI face of obs/trace.py + obs/report.py."""
+    md = str(tmp_path / "trace.md")
+    fig = str(tmp_path / "trace.png")
+    rc = main([
+        "trace", "--protocol", "basic", "--n", "3", "--f", "1",
+        "--clients", "1", "--commands", "4", "--conflict", "100",
+        "--window", "100", "--windows", "32", "--md", md, "--plot", fig,
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["window_ms"] == 100 and not out["truncated"]
+    ch = out["channels"]
+    # 2 client regions x 1 client x 4 commands, all complete
+    assert ch["done"]["total"] == 8
+    assert ch["submit"]["total"] == 8
+    assert ch["commit"]["total"] > 0
+    assert ch["deliver"]["total"] > 0
+    assert "max_gap_ms" in ch["done"]["stall"]
+    import os
+
+    assert os.path.exists(md) and os.path.exists(fig)
+    with open(md) as f:
+        assert "| done |" in f.read()
+
+
 def test_cli_shard_distribution(capsys):
     rc = main(
         [

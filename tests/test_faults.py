@@ -287,6 +287,90 @@ def test_partition_window_heals():
     assert int(st.faulted) > 0
 
 
+def test_partition_of_quorum_member_heals():
+    """ROADMAP fault follow-up: PARTITION windows feed the perfect failure
+    detector exactly like crashes. Partitioning a process that IS in the
+    coordinator's static quorum must not stall the run: quorum selection
+    during the window avoids the cut-off member (dynamic masks), and once
+    the window heals the static quorums return. The window opens at t=0 so
+    no in-flight command straddles the cut's opening edge, and clients sit
+    only on the surviving side (a client connected to a cut-off process
+    stalls by contract, like one on a crashed process; commands whose
+    quorum loses a member mid-flight also still stall — the coordinator
+    re-send item stays open). Pre-change this run stalled to the deadline:
+    the coordinator's static fast quorum {0, 1} kept including the cut-off
+    member and its MStore acks were lost across the cut."""
+    from fantoch_tpu.protocols import basic as basic_proto
+
+    planet = Planet.new()
+    config = Config(n=3, f=1, gc_interval_ms=20)
+    wl = Workload(1, KeyGen.conflict_pool(100, 2), 1, 8)
+    pdef = basic_proto.make_protocol(3, 1)
+    spec = setup.build_spec(
+        config, wl, pdef, n_clients=2, n_client_groups=1, extra_ms=1000,
+        max_steps=5_000_000, faults=True, deadline_ms=60_000,
+    )
+    # both clients in us-west1 -> connected to process 0, whose static
+    # fast quorum is {0, 1} (us-west2 closest); cut process 1 off for the
+    # first 800 ms, heal mid-run
+    placement = setup.Placement(
+        ["us-west1", "us-west2", "europe-west2"], ["us-west1"], 2
+    )
+    sched = FaultSchedule(partition=([1], 0, 800))
+    env = setup.build_env(spec, config, planet, placement, wl, pdef,
+                          faults=sched)
+    st = run(spec, pdef, wl, env)
+    assert bool(st.all_done), (
+        "quorums must re-form around the partitioned member"
+    )
+    assert int(st.dropped) == 0
+    # commits broadcast to all: the cut-off member missed the window's
+    # commits (lost across the cut), the survivors did not
+    cc = np.asarray(st.proto.commit_count)
+    assert int(st.faulted) > 0
+    assert cc[1] < cc[0]
+    # during the window the coordinator's commands committed via the
+    # re-formed {0, 2} quorum: europe round trips, visibly slower than the
+    # ~10 ms us-west1<->us-west2 fast path — and commands after the heal
+    # returned to it, so the mean sits between the two
+    assert int(st.lat_cnt.sum()) == 16
+
+
+def test_dynamic_masks_avoid_partitioned_members():
+    """During the partition window each side's quorum masks exclude the
+    other side; after it heals the static masks return."""
+    import jax.numpy as jnp
+
+    from fantoch_tpu.engine.faults import dynamic_masks, dynamic_masks_row
+
+    cfg = CONFIGS["basic"]
+    spec, pdef, wl, env = build(
+        "basic", cfg, FaultSchedule(partition=([1], 100, 300))
+    )
+    env_j = jax.tree_util.tree_map(jnp.asarray, env)
+    during = dynamic_masks(env_j, cfg["n"], jnp.full((3,), 150, jnp.int32))
+    after = dynamic_masks(env_j, cfg["n"], jnp.full((3,), 350, jnp.int32))
+    for mask in during:
+        m = np.asarray(mask)
+        # sides 0 and 2 never pick 1; side 1 never picks 0 or 2
+        assert not (m[[0, 2]] & 0b010).any()
+        assert not (m[1] & 0b101).any()
+    # healed: back to the static construction
+    np.testing.assert_array_equal(np.asarray(after[0]),
+                                  np.asarray(env.fq_mask))
+    np.testing.assert_array_equal(np.asarray(after[1]),
+                                  np.asarray(env.wq_mask))
+    # the quantum runner's per-row form agrees (engine equality under
+    # partitions rests on this)
+    for p in range(3):
+        fq_r, wq_r, maj_r = dynamic_masks_row(
+            env_j, cfg["n"], jnp.int32(p), jnp.int32(150)
+        )
+        assert int(fq_r) == int(np.asarray(during[0])[p])
+        assert int(wq_r) == int(np.asarray(during[1])[p])
+        assert int(maj_r) == int(np.asarray(during[2])[p])
+
+
 def test_duplication_is_harmless_for_sender_masked_quorums():
     """30% duplication: FPaxos quorums are sender bitmasks (like the synod
     ones the model checker exercises), so duplicates cannot double-count

@@ -105,9 +105,15 @@ CASES = [
     pytest.param("tempo", False, marks=pytest.mark.heavy),
     ("atlas", False),
     # the two protocols with the most tie-sensitive logic (wait condition;
-    # leader serialization) — round-3 verdict weak #6
-    ("caesar", False),
-    ("fpaxos", False),
+    # leader serialization) — round-3 verdict weak #6. Caesar's A/B pair is
+    # this file's heaviest compile (unwindowed dot space, wait-condition
+    # bitmaps): slow tier so the tier-1 budgeted run reaches the
+    # alphabetical tail (its exact-contract coverage stays in tier-1 via
+    # the caesar native-oracle cases)
+    pytest.param("caesar", False, marks=pytest.mark.slow),
+    # fpaxos A/B: the leader serialization is also pinned by its native
+    # oracle (exact loop) and the quantum equality suite — slow tier
+    pytest.param("fpaxos", False, marks=pytest.mark.slow),
 ]
 
 

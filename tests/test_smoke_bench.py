@@ -48,6 +48,13 @@ def test_bench_smoke_all_six_protocols():
     for name, rec in last["per_protocol"].items():
         assert rec["events"] > 0, (name, rec)
         assert rec["wall_s"] > 0, (name, rec)
+        # smoke runs with BENCH_TRACE on: the device trace recorder rides
+        # the timed megachunk program and its digest lands per protocol
+        tr = rec.get("trace")
+        assert tr, (name, "missing trace summary")
+        assert tr["totals"]["done"] > 0, (name, tr)
+        assert tr["totals"]["commit"] > 0, (name, tr)
+        assert tr["windows_active"] > 0, (name, tr)
 
     # incremental aggregates: at least one partial line must precede the
     # final one (the crash-containment property the round-4/5 benches

@@ -1,0 +1,267 @@
+"""Device-resident windowed trace recorder (obs/trace.py + obs/report.py).
+
+The contract under test, in order of importance:
+
+1. **Bit-identity**: compiling the trace recorder into a run must not
+   change a single simulation observable — lockstep, megachunk and the
+   distributed quantum runner all produce leaf-for-leaf identical results
+   with tracing on and off (the trace tensors are write-only side state).
+2. **Totals**: every per-window channel must sum to the run's own ground
+   truth — `client_latencies` issued counts, protocol metric totals,
+   latency record counts — across protocol families (basic: slot
+   replication; tempo: votes table with fast/slow paths; fpaxos: leader).
+3. **Timelines**: a fault schedule's trace visibly shows the crash dip and
+   the failover recovery edge per window, detected by the stall detector
+   (the ISSUE 3 acceptance criterion).
+"""
+import dataclasses
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.planet import Planet
+from fantoch_tpu.core.workload import KeyGen, Workload
+from fantoch_tpu.engine import lockstep, setup, summary, sweep
+from fantoch_tpu.obs import report as obs_report
+from fantoch_tpu.obs.trace import TraceSpec
+from fantoch_tpu.exp.harness import Point, run_point_traced
+
+REGIONS3 = ["asia-east1", "us-central1", "us-west1"]
+CREGIONS = ["us-west1", "us-west2"]
+TSPEC = TraceSpec(window_ms=50, max_windows=64)
+
+
+def _build(name, cmds=6, conflict=100, trace=None, leader=None):
+    from fantoch_tpu.protocols import basic, fpaxos, tempo
+
+    planet = Planet.new()
+    config = Config(n=3, f=1, gc_interval_ms=100, leader=leader)
+    wl = Workload(1, KeyGen.conflict_pool(conflict, 2), 1, cmds)
+    pdef = {"basic": basic, "tempo": tempo, "fpaxos": fpaxos}[
+        name
+    ].make_protocol(3, 1)
+    spec = setup.build_spec(
+        config, wl, pdef, n_clients=2, n_client_groups=2, extra_ms=1000,
+        max_steps=5_000_000, trace=trace,
+    )
+    placement = setup.Placement(REGIONS3, CREGIONS, 1)
+    env = setup.build_env(spec, config, planet, placement, wl, pdef)
+    return spec, pdef, wl, env
+
+
+def _run(spec, pdef, wl, env):
+    st = jax.jit(lockstep.make_run(spec, pdef, wl))(env)
+    return jax.tree_util.tree_map(np.asarray, st)
+
+
+def _assert_sim_equal(a, b):
+    """Leaf-for-leaf equality of the NON-trace state."""
+    fa, ta = jax.tree_util.tree_flatten(a._replace(trace=None))
+    fb, tb = jax.tree_util.tree_flatten(b._replace(trace=None))
+    assert ta == tb
+    for i, (x, y) in enumerate(zip(fa, fb)):
+        np.testing.assert_array_equal(x, y, err_msg=f"leaf {i}")
+
+
+def test_trace_spec_static_and_disabled_leaf_none():
+    # TraceSpec rides in SimSpec: both must stay hashable (compile-cache
+    # and conftest engine_runs keys) and a disabled spec must carry trace
+    # as None — an EMPTY pytree node, zero extra leaves in the program
+    spec, pdef, wl, env = _build("basic", cmds=3)
+    assert spec.trace is None and hash(spec) is not None
+    spec_t = dataclasses.replace(spec, trace=TSPEC)
+    assert hash(spec_t) is not None
+    eng = lockstep.make_engine(spec, pdef, wl)
+    st0 = eng.init_state(jax.tree_util.tree_map(np.asarray, env))
+    assert st0.trace is None
+    leaves_off = len(jax.tree_util.tree_flatten(st0)[0])
+    eng_t = lockstep.make_engine(spec_t, pdef, wl)
+    st1 = eng_t.init_state(jax.tree_util.tree_map(np.asarray, env))
+    assert isinstance(st1.trace, dict) and "submit" in st1.trace
+    assert len(jax.tree_util.tree_flatten(st1._replace(trace=None))[0]) \
+        == leaves_off
+
+
+@pytest.mark.parametrize("name", ["basic", "tempo", "fpaxos"])
+def test_trace_bit_identity_and_totals(name):
+    leader = 1 if name == "fpaxos" else None
+    spec0, pdef, wl, env = _build(name, leader=leader)
+    spec1 = dataclasses.replace(spec0, trace=TSPEC)
+    st0 = _run(spec0, pdef, wl, env)
+    st1 = _run(spec1, pdef, wl, env)
+    summary.check_sim_health(st0)
+    summary.check_sim_health(st1)
+    assert st0.trace is None
+    _assert_sim_equal(st0, st1)
+
+    tr = {k: np.asarray(v) for k, v in st1.trace.items()}
+    # per-channel totals vs the run's own ground truth
+    lats = summary.client_latencies(st1, env, CREGIONS)
+    issued_by_region = {r: c for r, (c, _h) in lats.items()}
+    group = np.asarray(env.client_group)
+    for g, region in enumerate(CREGIONS):
+        assert int(tr["issued"][:, g].sum()) == issued_by_region[region]
+        done_g = int(np.asarray(st1.lat_cnt)[group == g].sum())
+        assert int(tr["done"][:, g].sum()) == done_g
+    assert int(tr["submit"].sum()) == int(np.asarray(st1.next_seq).sum()) - 3
+    metrics = summary.protocol_metrics(st1, pdef)
+    np.testing.assert_array_equal(
+        tr["commit"].sum(axis=0), metrics["commits"]
+    )
+    if name == "tempo":  # fast/slow channels exist for quorum protocols
+        np.testing.assert_array_equal(tr["fast"].sum(axis=0), metrics["fast"])
+        np.testing.assert_array_equal(tr["slow"].sum(axis=0), metrics["slow"])
+    else:
+        assert "fast" not in tr and "slow" not in tr
+    assert int(tr["deliver"].sum()) > 0 and int(tr["insert"].sum()) > 0
+    assert int(tr["pool_hw"].max()) > 0
+
+
+def test_trace_megachunk_bit_identity():
+    """The megachunk driver (donated state, device-resident loop) produces
+    the identical trace AND identical sim results as the single-program
+    run — tracing composes with the PR 2 driver unchanged."""
+    spec0, pdef, wl, env = _build("basic", cmds=5)
+    spec = dataclasses.replace(spec0, trace=TSPEC)
+    envs = sweep.stack_envs([env, env])
+    full = sweep.run_batch(spec, pdef, wl, envs)
+    full = jax.tree_util.tree_map(np.asarray, full)
+
+    init, mega = sweep.make_megachunk_runner(spec, pdef, wl,
+                                             chunk_steps=40, k=3)
+    st = init(envs)
+    fin = 0
+    syncs = 0
+    while not fin:
+        st, d = mega(envs, st)
+        syncs += 1
+        fin = int(d)
+    st = jax.tree_util.tree_map(np.asarray, st)
+    _assert_sim_equal(full, st)
+    for k in full.trace:
+        np.testing.assert_array_equal(full.trace[k], st.trace[k],
+                                      err_msg=f"trace[{k}]")
+    assert syncs >= 2  # the loop actually exercised several megachunks
+
+
+def test_trace_quantum_bit_identity_and_totals():
+    """Trace-on vs trace-off bit-identity of the distributed quantum
+    runner, plus the runner's channel totals against its own counters."""
+    from fantoch_tpu.parallel import quantum
+
+    spec0, pdef, wl, env = _build("basic", cmds=4)
+    spec1 = dataclasses.replace(spec0, trace=TSPEC)
+    mesh = quantum.make_mesh(3)
+    r0 = quantum.build_runner(spec0, pdef, wl, env)
+    st0 = jax.tree_util.tree_map(
+        np.asarray, r0.run_sharded(mesh, r0.init_state())
+    )
+    r1 = quantum.build_runner(spec1, pdef, wl, env)
+    st1 = jax.tree_util.tree_map(
+        np.asarray, r1.run_sharded(mesh, r1.init_state())
+    )
+    assert st0.trace is None and bool(st0.all_done) and bool(st1.all_done)
+    _assert_sim_equal(st0, st1)
+    tr = {k: np.asarray(v) for k, v in st1.trace.items()}
+    assert int(tr["submit"].sum()) == spec0.n_clients * 4
+    assert int(tr["commit"].sum()) == int(
+        np.asarray(st1.proto.commit_count).sum()
+    )
+    assert int(tr["deliver"].sum()) == int(np.asarray(st1.step).sum())
+    assert int(tr["issued"].sum()) == int(np.asarray(st1.c_issued).sum())
+    assert int(tr["done"].sum()) == int(np.asarray(st1.lat_cnt).sum())
+    assert int(tr["insert"].sum()) > 0
+
+
+def test_stall_detector_units():
+    s = obs_report.stall_stats([0, 0, 3, 1, 0, 0, 0, 2, 0, 0], 100)
+    # longest silence: windows 4-6 before the window-7 activity (4 windows
+    # from the last activity at window 3)
+    assert s["max_gap_ms"] == 400.0
+    assert s["gap_start_ms"] == 400.0 and s["gap_end_ms"] == 800.0
+    # leading silence counts (recovery_stats measures from t=0)
+    s = obs_report.stall_stats([0, 0, 0, 0, 5, 5], 100)
+    assert s["max_gap_ms"] == 500.0 and s["gap_start_ms"] == 0.0
+    assert obs_report.stall_stats([0, 0, 0], 100)["max_gap_ms"] == 0.0
+    assert obs_report.stall_stats([4, 4, 4], 100)["max_gap_ms"] == 100.0
+
+
+def test_trace_fault_timeline_shows_crash_dip_and_failover(tmp_path):
+    """ISSUE 3 acceptance: an FPaxos leader-crash run's trace timeline
+    shows the outage as a per-window dip (the stall detector finds a gap
+    at least the detection timeout long) and the failover recovery edge
+    (completions resume after the gap). The crashed channel pins WHO was
+    down and WHEN."""
+    pt = Point(
+        protocol="fpaxos", n=3, f=1, clients_per_region=1,
+        commands_per_client=8, open_loop_interval_ms=40,
+        crash=((0, 250, -1),), leader_check_interval_ms=10,
+        deadline_ms=120_000, seed=0,
+    )
+    tspec = TraceSpec(window_ms=50, max_windows=128)
+    st, spec, env, cregions = run_point_traced(
+        pt, tspec,
+        process_regions=["europe-west2", "us-west1", "us-west2"],
+        client_regions=["us-west1", "us-west2"],
+    )
+    assert bool(st.all_done), "clients must complete after the failover"
+    rep = obs_report.drain(st, tspec, cregions)
+
+    # the crash dip: completions pause for at least the ~200 ms leader
+    # detection timeout, well under the run bound
+    stall = rep["channels"]["done"]["stall"]
+    assert stall["max_gap_ms"] >= 150, stall
+    assert stall["max_gap_ms"] < 5_000, stall
+    # the recovery edge: completions RESUME after the gap closes
+    per_window = np.asarray(rep["channels"]["done"]["per_window"])
+    edge = int(stall["gap_end_ms"]) // tspec.window_ms
+    assert per_window[edge:].sum() > 0, "no completions after the gap"
+    # commits dip and resume too (the protocol-side view of the outage)
+    commit_stall = rep["channels"]["commit"]["stall"]
+    assert commit_stall["max_gap_ms"] >= 100
+    # the crashed channel pins the victim: process 0 down from ~250 ms on
+    crashed = np.asarray(st.trace["crashed"])
+    w_crash = 250 // tspec.window_ms
+    assert crashed[w_crash + 1:, 0].max() == 1
+    assert crashed[:, 1].max() == 0 and crashed[:, 2].max() == 0
+
+    # report renderers + the plot family next to recovery_plot
+    md = obs_report.render_markdown(rep, title="failover")
+    assert "done" in md and "max gap" in md
+    from fantoch_tpu.plot import plots
+
+    out = plots.trace_timeline(rep, str(tmp_path / "trace.png"))
+    assert os.path.exists(out)
+
+
+def test_trace_report_and_db_roundtrip(tmp_path):
+    """Harness persistence: run_grid with a TraceSpec lands trace arrays
+    in data.npz (ResultsDB serves them per entry) and renders trace.json/
+    trace.md next to it."""
+    import json
+
+    from fantoch_tpu.exp.harness import run_grid
+    from fantoch_tpu.plot.db import ResultsDB
+
+    root = str(tmp_path / "results")
+    pts = [Point(protocol="basic", n=3, f=1, clients_per_region=1,
+                 commands_per_client=4, seed=s) for s in (0, 1)]
+    dirs = run_grid(pts, results_root=root, name="tr",
+                    trace=TraceSpec(window_ms=100, max_windows=32))
+    assert len(dirs) == 1
+    assert os.path.exists(os.path.join(dirs[0], "trace.json"))
+    assert os.path.exists(os.path.join(dirs[0], "trace.md"))
+    with open(os.path.join(dirs[0], "trace.json")) as f:
+        reports = json.load(f)
+    assert len(reports) == 2
+    assert reports[0]["report"]["channels"]["done"]["total"] == 8
+
+    db = ResultsDB.load(root)
+    assert len(db) == 2
+    for e in db:
+        assert "done" in e.traces and "submit" in e.traces
+        assert int(e.traces["done"].sum()) == 8
+        assert e.traces["done"].shape[0] == 32

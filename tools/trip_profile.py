@@ -16,6 +16,9 @@ device-resident megachunk driver (engine/sweep.py make_megachunk_runner)
 on a full run of one protocol: dispatch counts (host syncs), wall time,
 events/sec, and compiled HLO line counts of both programs — the
 measurement behind the bench's O(chunks) -> O(megachunks) host-sync claim.
+It also runs a TRACE-ENABLED megachunk (obs/trace.py) and FAILS if the
+trace recorder added a single host sync — the device-residency proof of
+the windowed trace subsystem.
 
 Usage:  python tools/trip_profile.py [tempo] [--batches 64,256,1024]
         python tools/trip_profile.py tempo --drivers [--batch 64] [--mega-k 4]
@@ -162,38 +165,66 @@ def compare_drivers(name, B=64, chunk_steps=None, k=4, cmds=25):
     }
 
     # device-resident megachunk driver (one int8 host sync per k chunks,
-    # donated state)
-    minit, mega = sweep.make_megachunk_runner(spec, pdef, wl, cs, k=k)
-    mst0 = minit(envs)
-    jax.block_until_ready(mst0)
-    wst, wd = mega(envs, mst0)  # warm (donates mst0)
-    jax.block_until_ready(wst)
-    del wst, wd
-    mhlo = hlo_lines(mega, envs, minit(envs))
-    t0 = time.time()
-    mst = minit(envs)
-    m = 0
-    fin = 0
-    while not fin:
-        mst, d = mega(envs, mst)
-        m += 1
-        fin = int(d)
-    jax.block_until_ready(mst)
-    mdt = time.time() - t0
-    mev = int(np.asarray(mst.step).sum())
-    out["megachunk"] = {
-        "dispatches": m,
-        "host_syncs": m,  # the int8 done flag is the only per-call pull
-        "wall_s": round(mdt, 3),
-        "events": mev,
-        "events_per_sec": round(mev / max(mdt, 1e-9), 1),
-        "hlo_lines": mhlo,
-    }
+    # donated state); the SAME warm/time/record loop then measures the
+    # trace-enabled build so the sync comparison is apples to apples
+    def timed_mega(mspec):
+        minit, mega = sweep.make_megachunk_runner(mspec, pdef, wl, cs, k=k)
+        mst0 = minit(envs)
+        jax.block_until_ready(mst0)
+        wst, wd = mega(envs, mst0)  # warm (donates mst0)
+        jax.block_until_ready(wst)
+        del wst, wd
+        mhlo = hlo_lines(mega, envs, minit(envs))
+        t0 = time.time()
+        mst = minit(envs)
+        m = 0
+        fin = 0
+        while not fin:
+            mst, d = mega(envs, mst)
+            m += 1
+            fin = int(d)
+        jax.block_until_ready(mst)
+        mdt = time.time() - t0
+        mev = int(np.asarray(mst.step).sum())
+        return m, {
+            "dispatches": m,
+            "host_syncs": m,  # the int8 done flag is the only per-call pull
+            "wall_s": round(mdt, 3),
+            "events": mev,
+            "events_per_sec": round(mev / max(mdt, 1e-9), 1),
+            "hlo_lines": mhlo,
+        }, mev, mdt
+
+    m, out["megachunk"], mev, mdt = timed_mega(spec)
     assert mev == ev, f"driver divergence: {mev} != {ev} events"
     out["sync_reduction"] = round((n + 1) / max(m, 1), 2)
+
+    # trace-enabled megachunk: the device-resident trace recorder
+    # (obs/trace.py) must add ZERO host syncs — the per-window tensors ride
+    # in the donated state and bin inside the jitted step, so the dispatch
+    # count is identical to the trace-off megachunk. Fail loudly if not:
+    # that would mean a trace build silently re-introduced the per-chunk
+    # host pull the megachunk driver exists to remove.
+    import dataclasses as _dc
+
+    from fantoch_tpu.obs.trace import TraceSpec
+
+    tspec = TraceSpec(window_ms=250, max_windows=128)
+    mt, out["megachunk_trace"], xev, xdt = timed_mega(
+        _dc.replace(spec, trace=tspec)
+    )
+    out["megachunk_trace"]["extra_host_syncs"] = mt - m
+    if mt != m:
+        raise SystemExit(
+            f"{name}: trace-enabled megachunk used {mt} host syncs vs"
+            f" {m} trace-off — the trace recorder must be device-resident"
+        )
+    assert xev == ev, f"trace run diverged: {xev} != {ev} events"
+
     print(f"{name}: chunk {n} dispatches / {dt:.2f}s vs megachunk(k={k}) "
           f"{m} dispatches / {mdt:.2f}s -> {out['sync_reduction']}x fewer"
-          " host syncs", file=sys.stderr, flush=True)
+          f" host syncs; trace-enabled megachunk {mt} dispatches /"
+          f" {xdt:.2f}s (+{mt - m} syncs)", file=sys.stderr, flush=True)
     return out
 
 
