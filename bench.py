@@ -102,6 +102,19 @@ SMOKE = os.environ.get("BENCH_SMOKE") == "1"
 # ride the aggregate's per_protocol records.
 BENCH_TRACE = SMOKE or os.environ.get("BENCH_TRACE") == "1"
 
+# trace-fed stall watchdog (obs/report.live_stall_gap_ms): a timed run whose
+# own done channel has been silent for this much SIMULATED time while the
+# clock kept advancing is wedged — abort it early and mark the protocol's
+# record (stall_abort rides the trace digest and forces the aggregate's
+# partial marker) instead of burning the remaining budget slice on it.
+# Requires BENCH_TRACE (the done channel must be compiled in); 0 in either
+# knob disables the watchdog.
+# The check pulls the done tensor from the LAST megachunk's output every
+# STALL_CHECK_EVERY dispatches — a bounded extra host pull, far rarer than
+# the per-chunk pulls the megachunk driver removed.
+STALL_GAP_MS = int(os.environ.get("BENCH_STALL_GAP_MS", "15000"))
+STALL_CHECK_EVERY = int(os.environ.get("BENCH_STALL_CHECK_EVERY", "4"))
+
 # chunks folded into one device call by the megachunk driver. The RUNS chunk
 # lengths each stay well under the tunnel's ~40s stall watchdog; a megachunk
 # multiplies single-call runtime by up to this factor, so keep the product
@@ -367,6 +380,12 @@ def device_golden(name, cmds=6):
 # timed runs
 # ---------------------------------------------------------------------------
 
+def _done_series(done, tspec):
+    """Batch-summed per-window done timeline: [B, W, G] -> [W]."""
+    done = np.asarray(done)
+    return done.reshape(done.shape[0], tspec.max_windows, -1).sum(axis=(0, 2))
+
+
 def trace_summary_of(st, tspec):
     """Compact trace digest of a finished batched state (None when the
     trace recorder was off): per-channel totals summed over the batch and
@@ -382,9 +401,7 @@ def trace_summary_of(st, tspec):
             int(arr.max()) if name == "pool_hw" else int(arr.sum())
         )
     if "done" in st.trace:
-        done = np.asarray(st.trace["done"])
-        per_window = done.reshape(done.shape[0], tspec.max_windows, -1)
-        series = per_window.sum(axis=(0, 2))  # [W], batch-summed
+        series = _done_series(st.trace["done"], tspec)
         out["windows_active"] = int((series > 0).sum())
         out["done_max_gap_ms"] = obs_report.stall_stats(
             series, tspec.window_ms
@@ -392,12 +409,34 @@ def trace_summary_of(st, tspec):
     return out
 
 
+def trace_stall_gap_ms(st, tspec):
+    """Done-channel silence of a batched IN-FLIGHT state, in simulated ms
+    (obs/report.live_stall_gap_ms over the batch-summed series, measured
+    against the furthest still-running config's clock). None when every
+    config has finished or the state carries no done channel."""
+    from fantoch_tpu.engine.types import INF_TIME
+    from fantoch_tpu.obs import report as obs_report
+
+    tr = getattr(st, "trace", None)
+    if tspec is None or tr is None or "done" not in tr:
+        return None
+    now = np.asarray(st.now)
+    running = now[now < INF_TIME]
+    if running.size == 0:
+        return None
+    return obs_report.live_stall_gap_ms(
+        _done_series(tr["done"], tspec), int(running.max()), tspec.window_ms
+    )
+
+
 def timed_run(pdef, n_configs, commands_per_client, window, chunk_steps,
               pool_slots, seed0=0, leader=None):
     """Megachunk-driven timed run: up to MEGA_K chunks per device call, one
     int8 host sync per megachunk, donated state (updated in place). With
     BENCH_TRACE the device trace recorder rides in the same program —
-    identical dispatch count, summary returned alongside the rate."""
+    identical dispatch count, summary returned alongside the rate — and
+    the run's OWN done channel feeds a stall watchdog: a wedged run aborts
+    early with stall_abort marked in its trace digest."""
     tspec = trace_spec()
     spec, wl, envs = build_batch(
         pdef, n_configs, commands_per_client, window,
@@ -413,6 +452,7 @@ def timed_run(pdef, n_configs, commands_per_client, window, chunk_steps,
     st = init(envs)
     dispatches = 0
     done = False
+    stall_gap = None
     while not done:
         if budget_left() < 45:
             log("  budget: aborting timed run mid-run (partial events kept)")
@@ -420,6 +460,16 @@ def timed_run(pdef, n_configs, commands_per_client, window, chunk_steps,
         st, d = mega(envs, st)
         dispatches += 1
         done = bool(d)  # the ONLY per-dispatch host sync: one int8
+        if (not done and tspec is not None and STALL_GAP_MS > 0
+                and STALL_CHECK_EVERY > 0
+                and dispatches % STALL_CHECK_EVERY == 0):
+            gap = trace_stall_gap_ms(st, tspec)
+            if gap is not None and gap > STALL_GAP_MS:
+                stall_gap = gap
+                log(f"    stall watchdog: done channel silent for"
+                    f" {gap:.0f} simulated ms (> {STALL_GAP_MS}) —"
+                    " aborting the wedged run")
+                break
     jax.block_until_ready(st)
     elapsed = time.time() - t0
     res = sweep.summarize_batch(st)
@@ -427,7 +477,13 @@ def timed_run(pdef, n_configs, commands_per_client, window, chunk_steps,
     ok = bool(res["all_done"].all()) and int(res["dropped"].sum()) == 0
     log(f"    megachunk: {dispatches} dispatches x (<= {MEGA_K} chunks of"
         f" {chunk_steps} steps), {events} events")
-    return events, elapsed, ok, trace_summary_of(st, tspec)
+    tsum = trace_summary_of(st, tspec)
+    if stall_gap is not None:
+        ok = False
+        tsum = dict(tsum or {})
+        tsum["stall_abort"] = True
+        tsum["stall_gap_ms"] = stall_gap
+    return events, elapsed, ok, tsum
 
 
 def run_protocol(name, n_configs, commands_per_client, chunk_steps,
@@ -709,13 +765,15 @@ def _spawn_worker(smoke):
 # aggregation + parent driver
 # ---------------------------------------------------------------------------
 
-def aggregate_line(per_protocol, expected, partial):
+def aggregate_line(per_protocol, expected, partial, lint=None):
     """One complete headline JSON line from whatever has finished so far.
 
     `partial` marks a mid-bench incremental line; the FINAL line also
-    self-reports as partial when any expected protocol is missing or failed,
-    so a parser of the last stdout line can never mistake a truncated bench
-    for a complete one."""
+    self-reports as partial when any expected protocol is missing, failed,
+    or was stall-aborted by the trace watchdog, so a parser of the last
+    stdout line can never mistake a truncated bench for a complete one.
+    `lint` (smoke) attaches the static contract checker's digest; a failed
+    lint also forces the partial marker."""
     total_events = sum(r["events"] for r in per_protocol.values())
     total_time = sum(r["wall_s"] for r in per_protocol.values())
     events_per_sec = total_events / max(total_time, 1e-9)
@@ -734,6 +792,7 @@ def aggregate_line(per_protocol, expected, partial):
     ok_names = {
         k for k, r in per_protocol.items()
         if r.get("events", 0) > 0 and r.get("golden") is not False
+        and not (r.get("trace") or {}).get("stall_abort")
     }
     # a vacuous aggregate (nothing expected or nothing reported) must never
     # parse as a complete bench
@@ -754,7 +813,10 @@ def aggregate_line(per_protocol, expected, partial):
     }
     if SMOKE:
         out["smoke"] = True
-    if partial or not complete:
+    if lint is not None:
+        out["lint"] = lint
+    if partial or not complete or (lint is not None
+                                   and not lint.get("ok", False)):
         out["partial"] = True
         out["protocols_reported"] = sorted(ok_names)
         out["protocols_expected"] = list(expected)
@@ -909,9 +971,46 @@ def main():
     log(f"device goldens: {'ok' if goldens_ok else 'FAILED'}"
         + (f" ({len(attempted)}/{len(golden_names)} attempted)"
            if attempted or golden_names else ""))
+    # smoke: the static contract checker's digest rides the aggregate (the
+    # CI face of `python -m fantoch_tpu lint` — the full matrix is the slow
+    # tier; this fast subset proves the checker runs and the drivers under
+    # test lint clean). A violation forces the partial marker.
+    lint_digest = None
+    if SMOKE and budget_left() <= 45:
+        # budget exhausted before the checker could run: an ok=False digest
+        # (not a missing one) so the aggregate's partial marker fires — a
+        # smoke bench whose static checker never ran must not parse as
+        # complete
+        lint_digest = {"ok": False, "error": "skipped: budget exhausted"}
+        log("lint digest SKIPPED: budget exhausted")
+    elif SMOKE:
+        try:
+            t0 = time.time()
+            from fantoch_tpu.analysis import checker as lint_checker
+
+            rep = lint_checker.lint(
+                protocols=["basic"], engines=["lockstep"],
+                trace_variants=(False, True), fault_variants=(False,),
+                retrace=False,
+            )
+            lint_digest = {
+                "ok": bool(rep["ok"]),
+                "programs": len(rep["programs"]),
+                "violations": len(rep["violations"]),
+                "rules": rep["rules"],
+                "wall_s": round(time.time() - t0, 1),
+            }
+            if rep["violations"]:
+                lint_digest["first"] = rep["violations"][0]
+            log(f"lint digest: {lint_digest}")
+        except Exception as e:  # noqa: BLE001 — a digest failure is a FAIL
+            lint_digest = {"ok": False,
+                           "error": f"{type(e).__name__}: {e}"[:300]}
+            log(f"lint digest FAILED: {lint_digest['error']}")
     if not all_ok:
         print(json.dumps({"error": "simulation incomplete"}), file=sys.stderr)
-    print(aggregate_line(per_protocol, names, partial=False), flush=True)
+    print(aggregate_line(per_protocol, names, partial=False,
+                         lint=lint_digest), flush=True)
 
 
 if __name__ == "__main__":

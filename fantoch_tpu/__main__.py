@@ -171,9 +171,33 @@ def cmd_trace(args) -> int:
     """Run one configuration with the device-resident trace recorder and
     render its windowed timeline report (JSON on stdout; optional Markdown
     and figure files) — the in-run observability the reference's
-    metrics_logger file provides, at megachunk speed."""
-    from .exp.harness import Point, run_point_traced
+    metrics_logger file provides, at megachunk speed.
+
+    `--diff A B` instead compares two previously saved reports (`--json`
+    writes one): per-channel window deltas and the first-divergence
+    window — where two runs' timelines split."""
     from .obs import report as obs_report
+
+    if args.diff:
+        path_a, path_b = args.diff
+        try:
+            with open(path_a) as f:
+                rep_a = json.load(f)
+            with open(path_b) as f:
+                rep_b = json.load(f)
+            d = obs_report.diff_reports(rep_a, rep_b)
+        except (OSError, json.JSONDecodeError, ValueError) as e:
+            print(f"trace --diff: {e}", file=sys.stderr)
+            return 2
+        print(obs_report.render_json(d))
+        return 0
+
+    if not args.protocol:
+        print("trace: --protocol is required (unless --diff)",
+              file=sys.stderr)
+        return 2
+
+    from .exp.harness import Point, run_point_traced
     from .obs.trace import TraceSpec
 
     pt = Point(
@@ -202,6 +226,10 @@ def cmd_trace(args) -> int:
     )
     rep = obs_report.drain(st, tspec, cregions)
     print(obs_report.render_json(rep))
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(obs_report.render_json(rep))
+        print(f"json: {args.json_out}", file=sys.stderr)
     if args.md:
         with open(args.md, "w") as f:
             f.write(obs_report.render_markdown(
@@ -214,6 +242,82 @@ def cmd_trace(args) -> int:
         trace_timeline(rep, args.plot)
         print(f"figure: {args.plot}", file=sys.stderr)
     return 0
+
+
+def cmd_lint(args) -> int:
+    """Static engine-contract checker (fantoch_tpu/analysis): trace the
+    jitted engine programs for the requested protocol x engine x trace x
+    faults matrix and verify purity, dtype discipline, donation safety and
+    recompile-key hygiene. Exit 1 on any violation; `--json` prints the
+    full machine-readable report."""
+    # the quantum runner needs one device per process (n=3): force a
+    # virtual host mesh BEFORE jax initializes (no-op if already set or if
+    # jax is already imported — then the caller owns the device topology)
+    if "jax" not in sys.modules:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+
+    from .analysis import checker
+
+    protocols = _csv(args.protocols) or list(checker.PROTOCOLS)
+    engines = _csv(args.engines) or list(checker.ENGINES)
+    unknown = set(protocols) - set(checker.PROTOCOLS)
+    if unknown:
+        print(f"lint: unknown protocols {sorted(unknown)}", file=sys.stderr)
+        return 2
+    unknown = set(engines) - set(checker.ENGINES)
+    if unknown:
+        print(f"lint: unknown engines {sorted(unknown)}", file=sys.stderr)
+        return 2
+
+    variants = {}
+    for flag, s in (("trace", args.trace), ("faults", args.faults)):
+        # an empty CSV falls back to the full default, like
+        # --protocols/--engines — never a silent 0-program green matrix
+        vals = _csv(s) or ["off", "on"]
+        bad = set(vals) - {"on", "off"}
+        if bad:
+            print(f"lint: --{flag} takes a CSV of on,off"
+                  f" (got {sorted(bad)})", file=sys.stderr)
+            return 2
+        variants[flag] = tuple("on" == v for v in vals)
+
+    report = checker.lint(
+        protocols=protocols,
+        engines=engines,
+        trace_variants=variants["trace"],
+        fault_variants=variants["faults"],
+        retrace=not args.no_retrace,
+        verbose=args.verbose,
+    )
+    if args.json:
+        print(json.dumps(report))
+    else:
+        for v in report["violations"]:
+            print(f"[{v['rule']}] {v['program']} @ {v['path']}"
+                  + (f" :: {v['primitive']}" if v["primitive"] else "")
+                  + f": {v['detail']}")
+        for s in report["skipped"]:
+            print(f"skipped {s['program']}: {s['reason']}", file=sys.stderr)
+        print(
+            f"lint: {len(report['programs'])} programs,"
+            f" {len(report['violations'])} violation(s),"
+            f" {len(report['skipped'])} skipped"
+            f" [{'OK' if report['ok'] else 'FAIL'}]",
+            file=sys.stderr,
+        )
+    if not report["programs"]:
+        # every requested program was skipped (e.g. quantum on a
+        # too-small device mesh): a run that statically checked NOTHING
+        # must not exit green — the same vacuous-pass class as an empty
+        # variant CSV
+        print(f"lint: VACUOUS — 0 programs traced,"
+              f" {len(report['skipped'])} skipped", file=sys.stderr)
+        return 1
+    return 0 if report["ok"] else 1
 
 
 def cmd_plot(args) -> int:
@@ -504,7 +608,8 @@ def main(argv=None) -> int:
         help="run one config with the device trace recorder, print the"
              " windowed timeline report",
     )
-    pt.add_argument("--protocol", required=True)
+    pt.add_argument("--protocol", default="",
+                    help="required unless --diff is given")
     pt.add_argument("--n", type=int, default=3)
     pt.add_argument("--f", type=int, default=1)
     pt.add_argument("--clients", type=int, default=1)
@@ -529,7 +634,35 @@ def main(argv=None) -> int:
     pt.add_argument("--client-regions", default="")
     pt.add_argument("--md", default="", help="write a Markdown report here")
     pt.add_argument("--plot", default="", help="write a timeline figure here")
+    pt.add_argument("--json", default="", dest="json_out",
+                    help="also write the report JSON to this file"
+                         " (the input format of --diff)")
+    pt.add_argument("--diff", nargs=2, metavar=("A", "B"), default=None,
+                    help="compare two saved report JSONs instead of"
+                         " running: per-channel window deltas +"
+                         " first-divergence window")
     pt.set_defaults(fn=cmd_trace)
+
+    pl = sub.add_parser(
+        "lint",
+        help="static engine-contract checker: trace the jitted programs,"
+             " verify purity/dtype/donation/recompile-key rules"
+             " (exit 1 on violation)",
+    )
+    pl.add_argument("--protocols", default="",
+                    help="CSV subset (default: all six)")
+    pl.add_argument("--engines", default="",
+                    help="CSV of lockstep,sweep,quantum (default: all)")
+    pl.add_argument("--trace", default="off,on",
+                    help="trace variants to check (CSV of off,on)")
+    pl.add_argument("--faults", default="off,on",
+                    help="fault variants to check (CSV of off,on)")
+    pl.add_argument("--no-retrace", action="store_true",
+                    help="skip the retrace stability check (faster)")
+    pl.add_argument("--json", action="store_true",
+                    help="print the full JSON report on stdout")
+    pl.add_argument("--verbose", action="store_true")
+    pl.set_defaults(fn=cmd_lint)
 
     pp = sub.add_parser("plot", help="figures + stats from a results root")
     pp.add_argument("--results", default="results")

@@ -1,0 +1,34 @@
+"""Static engine-contract analysis (`python -m fantoch_tpu lint`).
+
+Traces the jitted engine programs (no compile, no execution) and verifies
+the engine contract rules — purity, dtype discipline, donation safety,
+recompile-key hygiene — over the full protocol x engine x trace x faults
+matrix. See analysis/checker.py for the driver and analysis/rules.py for
+the rule set.
+"""
+from .checker import (  # noqa: F401
+    ENGINES,
+    PROTOCOLS,
+    Program,
+    build_matrix,
+    build_point,
+    lint,
+    lockstep_programs,
+    program_from_traced,
+    purity_verdict,
+    quantum_programs,
+    run_check,
+    sweep_programs,
+)
+from .rules import (  # noqa: F401
+    ALL_RULES,
+    DonationRule,
+    DtypeRule,
+    Leaf,
+    PurityRule,
+    StaticKeyRule,
+    Violation,
+    check_trace_stability,
+    jaxpr_signature,
+    walk,
+)

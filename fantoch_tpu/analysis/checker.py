@@ -1,0 +1,529 @@
+"""Static contract checker: trace the engines' jitted programs, walk the
+jaxprs, verify the engine contract without running a single simulation.
+
+fantoch's value is that protocol implementations are *checked*, not
+trusted — the model checker and simulator catch protocol bugs before
+deployment. This module is the same idea applied to the ENGINE invariants
+the TPU port accumulated ("zero host syncs inside a megachunk", "donated
+state is never read after donation", "all counters are int32", "specs are
+hashable static recompile keys"): instead of enforcing them dynamically
+(tools/trip_profile.py counts dispatches at runtime) or by reviewer
+convention, every jitted driver program is traced with ``jax.jit(...)
+.trace(...)`` (no compilation, no execution) for all six protocols x
+trace-on/off x fault-on/off, the closed jaxprs are walked recursively
+(``while``/``cond``/``scan``/``pjit``/``shard_map`` sub-jaxprs included),
+and the rule set in analysis/rules.py is applied to each.
+
+Programs checked per (protocol, variant):
+
+- ``lockstep.run_chunk`` / ``lockstep.run_megachunk`` — the engine drivers,
+  jitted with the production donation contract (state donated);
+- ``sweep.megachunk`` / ``sweep.chunked`` — the REAL batched runner
+  callables from engine/sweep.py (vmapped, donating and non-donating);
+- ``quantum.run_sharded`` — the distributed runner's shard_map program
+  (requires >= 3 devices; recorded as skipped otherwise).
+
+Driver: ``python -m fantoch_tpu lint`` (exit 1 on violation, ``--json``
+report) and tests/test_lint.py (fast subset in tier-1, full matrix slow).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import rules as rules_mod
+from .rules import ALL_RULES, Leaf, Violation, jaxpr_signature
+
+PROTOCOLS = ("basic", "tempo", "atlas", "epaxos", "fpaxos", "caesar")
+ENGINES = ("lockstep", "sweep", "quantum")
+
+# tiny lint shapes: tracing cost only (no compile/run), so the smallest
+# config that still exercises every code path — 3 processes, 2 clients in
+# 2 regions, 3 commands
+_CMDS = 3
+_CHUNK_STEPS = 64
+_MEGA_K = 2
+_REGIONS = ("asia-east1", "us-central1", "us-west1")
+_CREGIONS = ("us-west1", "us-west2")
+
+
+# ---------------------------------------------------------------------------
+# program record
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Program:
+    """One traced jitted program plus everything the rules inspect."""
+
+    name: str  # display name, e.g. "lockstep.run_chunk[tempo|trace=on|faults=off]"
+    kind: str  # "lockstep.run_chunk", "sweep.megachunk", ...
+    protocol: str
+    engine: str  # "lockstep" | "sweep" | "quantum"
+    variant: Dict[str, str]  # {"trace": "on"/"off", "faults": ...}
+    jaxpr: Any  # ClosedJaxpr
+    args: List[Leaf]  # all flattened input leaves (donation flags set)
+    outs: List[Leaf]  # all flattened output leaves
+    state_in: List[Leaf]  # state-argument leaves, paths normalized
+    state_out: List[Leaf]  # state-output leaves, paths normalized
+    spec: Any  # SimSpec (None for synthetic rule-test programs)
+    statics: Tuple[Tuple[str, Any, str], ...]  # (name, obj, "hash"|"repr")
+    signature: str
+    key: Tuple  # compile-identity key (recompile-hygiene grouping)
+    expect_donation: bool = False  # driver must donate its state argument
+    forbid_donation: bool = False  # non-donating (checkpointing) contract
+    retrace_fn: Optional[Callable[[], str]] = None  # fresh-trace signature
+    eqn_count: int = 0
+
+
+def _keystr(kp) -> str:
+    import jax
+
+    return jax.tree_util.keystr(kp)
+
+
+def _strip(path: str, prefix: str) -> Optional[str]:
+    if prefix == "" or path.startswith(prefix):
+        return path[len(prefix):]
+    return None
+
+
+def program_from_traced(
+    traced,
+    *,
+    name: str,
+    kind: str,
+    protocol: str = "?",
+    engine: str = "?",
+    variant: Optional[Dict[str, str]] = None,
+    spec=None,
+    statics: Tuple[Tuple[str, Any, str], ...] = (),
+    state_in_prefix: str = "",
+    state_out_prefix: str = "",
+    expect_donation: bool = False,
+    forbid_donation: bool = False,
+    key: Optional[Tuple] = None,
+    retrace_fn=None,
+) -> Program:
+    """Build a `Program` from a ``jax.jit(...).trace(...)`` result.
+
+    `state_in_prefix`/`state_out_prefix` select the state portion of the
+    argument/output pytrees (e.g. "[1]" for ``fn(env, state)``, "[0]" for a
+    megachunk's ``(state, done)`` return) and normalize leaf paths so the
+    dtype-schema rule can match them positionally by name."""
+    import jax
+
+    arg_nodes = jax.tree_util.tree_flatten_with_path(traced.args_info)[0]
+    args = []
+    for kp, ai in arg_nodes:
+        aval = getattr(ai, "_aval", None)
+        # args_info is the (args, ...) tuple itself: every leaf path leads
+        # with the wrapper's "[0]" — strip it so "[i]..." is argument i,
+        # matching the state_in_prefix convention
+        path = _keystr(kp)
+        if path.startswith("[0]"):
+            path = path[3:]
+        args.append(Leaf(
+            path=path,
+            shape=tuple(ai.shape),
+            dtype=str(ai.dtype),
+            weak_type=bool(getattr(aval, "weak_type", False)),
+            donated=bool(getattr(ai, "donated", False)),
+        ))
+    out_nodes = jax.tree_util.tree_flatten_with_path(traced.out_info)[0]
+    out_avals = traced.jaxpr.out_avals
+    outs = []
+    for (kp, _oi), aval in zip(out_nodes, out_avals):
+        outs.append(Leaf(
+            path=_keystr(kp),
+            shape=tuple(getattr(aval, "shape", ())),
+            dtype=str(getattr(aval, "dtype", "?")),
+            weak_type=bool(getattr(aval, "weak_type", False)),
+        ))
+
+    def _select(leaves, prefix):
+        sel = []
+        for lf in leaves:
+            p = _strip(lf.path, prefix)
+            if p is not None:
+                sel.append(dataclasses.replace(lf, path=p))
+        return sel
+
+    sig = jaxpr_signature(traced.jaxpr, traced.jaxpr.in_avals)
+    eqns = sum(1 for _ in rules_mod.walk(traced.jaxpr.jaxpr))
+    return Program(
+        name=name, kind=kind, protocol=protocol, engine=engine,
+        variant=dict(variant or {}), jaxpr=traced.jaxpr, args=args,
+        outs=outs,
+        state_in=_select(args, state_in_prefix),
+        state_out=_select(outs, state_out_prefix),
+        spec=spec, statics=tuple(statics), signature=sig,
+        key=key if key is not None else (kind, protocol, repr(spec)),
+        expect_donation=expect_donation, forbid_donation=forbid_donation,
+        retrace_fn=retrace_fn, eqn_count=eqns,
+    )
+
+
+# ---------------------------------------------------------------------------
+# point construction (tiny shapes, all six protocols)
+# ---------------------------------------------------------------------------
+
+
+def _fault_schedule(mode: Optional[str]):
+    """The seeded lint fault schedule. "full" exercises every fault path
+    (crash + partition + drop/dup lotteries, lockstep only); "crash" is the
+    subset the distributed runner supports (deterministic functions of
+    time)."""
+    if mode is None:
+        return None
+    from ..engine import faults as faults_mod
+
+    if mode == "crash":
+        return faults_mod.FaultSchedule(crash={0: (200, 400)})
+    assert mode == "full", mode
+    return faults_mod.FaultSchedule(
+        crash={0: (200, 400)},
+        partition=((2,), 100, 160),
+        drop_pct=3,
+        dup_pct=3,
+    )
+
+
+def build_point(protocol: str, *, trace: bool = False,
+                faults: Optional[str] = None):
+    """(spec, pdef, wl, env, tspec) for one protocol at the lint shapes."""
+    from ..core.config import Config
+    from ..core.planet import Planet
+    from ..core.workload import KeyGen, Workload
+    from ..engine import setup
+    from ..protocols import atlas, basic, caesar, epaxos, fpaxos, tempo
+
+    mods = dict(basic=basic, tempo=tempo, atlas=atlas, epaxos=epaxos,
+                fpaxos=fpaxos, caesar=caesar)
+    assert protocol in mods, f"unknown protocol {protocol!r}"
+    C = len(_CREGIONS)  # 1 client per region
+    leader = 1 if protocol == "fpaxos" else None
+    planet = Planet.new()
+    config = Config(n=3, f=1, gc_interval_ms=100, leader=leader)
+    wl = Workload(1, KeyGen.conflict_pool(100, 2), 1, _CMDS)
+    if protocol == "caesar":
+        pdef = mods[protocol].make_protocol(3, 1, max_seq=C * _CMDS)
+    else:
+        pdef = mods[protocol].make_protocol(3, 1)
+    tspec = None
+    if trace:
+        from ..obs.trace import TraceSpec
+
+        tspec = TraceSpec(window_ms=100, max_windows=16)
+    sched = _fault_schedule(faults)
+    spec = setup.build_spec(
+        config, wl, pdef, n_clients=C, n_client_groups=len(_CREGIONS),
+        extra_ms=500, max_steps=100_000, trace=tspec,
+        faults=sched is not None,
+        faults_dup=bool(sched is not None and sched.dup_pct > 0),
+        deadline_ms=30_000 if sched is not None else None,
+    )
+    placement = setup.Placement(list(_REGIONS), list(_CREGIONS), 1)
+    env = setup.build_env(spec, config, planet, placement, wl, pdef,
+                          faults=sched)
+    return spec, pdef, wl, env, tspec
+
+
+def _vname(kind, protocol, trace, faults):
+    return (f"{kind}[{protocol}|trace={'on' if trace else 'off'}"
+            f"|faults={faults or 'off'}]")
+
+
+def _variant(trace, faults):
+    return {"trace": "on" if trace else "off", "faults": faults or "off"}
+
+
+def _statics_of(spec, tspec, wl):
+    return (
+        ("SimSpec", spec, "hash"),
+        ("TraceSpec", tspec, "hash"),
+        ("Workload", wl, "repr"),
+    )
+
+
+# ---------------------------------------------------------------------------
+# per-engine program builders
+# ---------------------------------------------------------------------------
+
+
+def lockstep_programs(protocol: str, *, trace: bool,
+                      faults: Optional[str]) -> List[Program]:
+    """run_chunk + run_megachunk, jitted with the production donation
+    contract (state argument donated, engine/sweep.py default)."""
+    import jax
+
+    from ..engine import lockstep
+
+    spec, pdef, wl, env, tspec = build_point(
+        protocol, trace=trace, faults=faults
+    )
+    eng = lockstep.make_engine(spec, pdef, wl)
+    st_sds = jax.eval_shape(eng.init_state, env)
+    statics = _statics_of(spec, tspec, wl)
+    out = []
+
+    chunk_traced = jax.jit(
+        lambda e, s: eng.run_chunk(e, s, _CHUNK_STEPS), donate_argnums=(1,)
+    ).trace(env, st_sds)
+
+    def retrace() -> str:
+        # a FRESH engine build for the same key: catches traces that bake
+        # in Python object ids or other per-build state
+        eng2 = lockstep.make_engine(spec, pdef, wl)
+        t2 = jax.jit(
+            lambda e, s: eng2.run_chunk(e, s, _CHUNK_STEPS),
+            donate_argnums=(1,),
+        ).trace(env, st_sds)
+        return jaxpr_signature(t2.jaxpr, t2.jaxpr.in_avals)
+
+    out.append(program_from_traced(
+        chunk_traced,
+        name=_vname("lockstep.run_chunk", protocol, trace, faults),
+        kind="lockstep.run_chunk", protocol=protocol, engine="lockstep",
+        variant=_variant(trace, faults), spec=spec, statics=statics,
+        state_in_prefix="[1]", state_out_prefix="",
+        expect_donation=True,
+        retrace_fn=retrace if protocol == "basic" else None,
+    ))
+    mega_traced = jax.jit(
+        lambda e, s: eng.run_megachunk(e, s, _CHUNK_STEPS, _MEGA_K),
+        donate_argnums=(1,),
+    ).trace(env, st_sds)
+    out.append(program_from_traced(
+        mega_traced,
+        name=_vname("lockstep.run_megachunk", protocol, trace, faults),
+        kind="lockstep.run_megachunk", protocol=protocol, engine="lockstep",
+        variant=_variant(trace, faults), spec=spec, statics=statics,
+        state_in_prefix="[1]", state_out_prefix="[0]",
+        expect_donation=True,
+    ))
+    return out
+
+
+def sweep_programs(protocol: str, *, trace: bool) -> List[Program]:
+    """The REAL batched runner callables (engine/sweep.py): the donating
+    vmapped megachunk (the bench's timed program) and, for the baseline
+    protocol, the non-donating chunked runner whose checkpointing contract
+    forbids donation."""
+    import jax
+
+    from ..engine import sweep
+
+    spec, pdef, wl, env, tspec = build_point(protocol, trace=trace)
+    envs = sweep.stack_envs([env, env])
+    statics = _statics_of(spec, tspec, wl)
+    out = []
+    init, mega = sweep.make_megachunk_runner(
+        spec, pdef, wl, chunk_steps=_CHUNK_STEPS, k=_MEGA_K
+    )
+    st_sds = jax.eval_shape(init, envs)
+    out.append(program_from_traced(
+        mega.trace(envs, st_sds),
+        name=_vname("sweep.megachunk", protocol, trace, None),
+        kind="sweep.megachunk", protocol=protocol, engine="sweep",
+        variant=_variant(trace, None), spec=spec, statics=statics,
+        state_in_prefix="[1]", state_out_prefix="[0]",
+        expect_donation=True,
+    ))
+    if protocol == "basic":
+        initc, chunk, _done = sweep.make_chunked_runner(
+            spec, pdef, wl, chunk_steps=_CHUNK_STEPS, donate=False
+        )
+        st_sds_c = jax.eval_shape(initc, envs)
+        out.append(program_from_traced(
+            chunk.trace(envs, st_sds_c),
+            name=_vname("sweep.chunked(donate=False)", protocol, trace, None),
+            kind="sweep.chunked", protocol=protocol, engine="sweep",
+            variant=_variant(trace, None), spec=spec, statics=statics,
+            state_in_prefix="[1]", state_out_prefix="",
+            forbid_donation=True,
+        ))
+    return out
+
+
+def quantum_programs(protocol: str, *, trace: bool,
+                     faults: Optional[str]) -> List[Program]:
+    """The distributed runner's shard_map program (one device per process:
+    needs >= 3 devices — callers catch RuntimeError and record a skip)."""
+    import jax
+
+    from ..parallel import quantum
+
+    if len(jax.devices()) < 3:
+        raise RuntimeError(
+            "quantum runner lint needs >= 3 devices (set XLA_FLAGS="
+            "--xla_force_host_platform_device_count=8 before importing jax)"
+        )
+    assert faults in (None, "crash"), (
+        "the distributed runner supports crash/partition schedules only"
+    )
+    spec, pdef, wl, env, tspec = build_point(
+        protocol, trace=trace, faults=faults
+    )
+    runner = quantum.build_runner(spec, pdef, wl, env)
+    mesh = quantum.make_mesh(3)
+    st0 = runner.init_state()
+    traced = jax.jit(lambda s: runner.run_sharded(mesh, s)).trace(st0)
+    return [program_from_traced(
+        traced,
+        name=_vname("quantum.run_sharded", protocol, trace, faults),
+        kind="quantum.run_sharded", protocol=protocol, engine="quantum",
+        variant=_variant(trace, faults), spec=spec,
+        statics=_statics_of(spec, tspec, wl),
+        state_in_prefix="[0]", state_out_prefix="",
+    )]
+
+
+# ---------------------------------------------------------------------------
+# matrix + check driver
+# ---------------------------------------------------------------------------
+
+
+def build_matrix(
+    protocols: Sequence[str] = PROTOCOLS,
+    engines: Sequence[str] = ENGINES,
+    trace_variants: Sequence[bool] = (False, True),
+    fault_variants: Sequence[bool] = (False, True),
+    verbose: bool = False,
+) -> Tuple[List[Program], List[Dict[str, str]]]:
+    """Trace the requested (protocol x engine x trace x faults) matrix.
+
+    Returns ``(programs, skips)``; a builder failure (e.g. too few devices
+    for the quantum runner) is recorded as a skip, never swallowed."""
+    import sys
+
+    programs: List[Program] = []
+    skips: List[Dict[str, str]] = []
+
+    def note(msg):
+        if verbose:
+            print(msg, file=sys.stderr, flush=True)
+
+    for proto in protocols:
+        for tr_on in trace_variants:
+            if "lockstep" in engines:
+                for f_on in fault_variants:
+                    fmode = "full" if f_on else None
+                    note(f"lint: tracing lockstep {proto}"
+                         f" trace={tr_on} faults={fmode}")
+                    programs += lockstep_programs(
+                        proto, trace=tr_on, faults=fmode
+                    )
+            if "sweep" in engines:
+                note(f"lint: tracing sweep {proto} trace={tr_on}")
+                programs += sweep_programs(proto, trace=tr_on)
+            if "quantum" in engines:
+                for f_on in fault_variants:
+                    fmode = "crash" if f_on else None
+                    note(f"lint: tracing quantum {proto}"
+                         f" trace={tr_on} faults={fmode}")
+                    try:
+                        programs += quantum_programs(
+                            proto, trace=tr_on, faults=fmode
+                        )
+                    except RuntimeError as e:
+                        skips.append({
+                            "program": _vname("quantum.run_sharded", proto,
+                                              tr_on, fmode),
+                            "reason": str(e),
+                        })
+    return programs, skips
+
+
+def run_check(programs: Sequence[Program], rules=ALL_RULES,
+              retrace: bool = True) -> Dict[str, Any]:
+    """Apply the rule set to every program; returns the JSON-able report.
+
+    Beyond the per-program rules, two cross-program recompile-hygiene
+    checks run here: (a) programs sharing a compile key must share a jaxpr
+    signature (same key, different trace = an avoidable recompile), and
+    (b) programs carrying a `retrace_fn` are re-traced from scratch and
+    must reproduce their signature bit-for-bit."""
+    violations: List[Violation] = []
+    by_key: Dict[Tuple, Tuple[str, str]] = {}
+    for p in programs:
+        for rule in rules:
+            violations.extend(rule.check(p))
+        if retrace and p.retrace_fn is not None:
+            violations.extend(
+                rules_mod.check_trace_stability(p, p.retrace_fn())
+            )
+        seen = by_key.get(p.key)
+        if seen is not None and seen[1] != p.signature:
+            violations.append(Violation(
+                rule="static-keys/key-collision", program=p.name,
+                path="compile-key", primitive="",
+                detail=f"same compile key as {seen[0]} but a different"
+                       " jaxpr signature — one of the two recompiles on"
+                       " every cache lookup",
+            ))
+        by_key.setdefault(p.key, (p.name, p.signature))
+    return {
+        "programs": [
+            {
+                "name": p.name,
+                "engine": p.engine,
+                "protocol": p.protocol,
+                "variant": p.variant,
+                "eqns": p.eqn_count,
+                "signature": p.signature,
+                "donated_leaves": sum(1 for lf in p.args if lf.donated),
+                # state leaves the dtype-schema rule actually compared —
+                # 0 on a state-carrying program means the check went
+                # vacuous (a path-normalization regression)
+                "schema_leaves": len(
+                    {lf.path for lf in p.state_in}
+                    & {lf.path for lf in p.state_out}
+                ),
+            }
+            for p in programs
+        ],
+        "rules": [r.id for r in rules],
+        "violations": [v.to_dict() for v in violations],
+        # a run that traced NOTHING (everything skipped) is vacuous, not
+        # clean — `ok` in the machine-readable report must agree with the
+        # CLI exit code, so --json consumers can trust it directly
+        "ok": not violations and len(programs) > 0,
+    }
+
+
+def lint(
+    protocols: Sequence[str] = PROTOCOLS,
+    engines: Sequence[str] = ENGINES,
+    trace_variants: Sequence[bool] = (False, True),
+    fault_variants: Sequence[bool] = (False, True),
+    retrace: bool = True,
+    verbose: bool = False,
+) -> Dict[str, Any]:
+    """Trace the matrix, run every rule, return the report dict."""
+    programs, skips = build_matrix(
+        protocols, engines, trace_variants, fault_variants, verbose=verbose
+    )
+    report = run_check(programs, retrace=retrace)
+    report["skipped"] = skips
+    report["matrix"] = {
+        "protocols": list(protocols),
+        "engines": list(engines),
+        "trace": ["on" if t else "off" for t in trace_variants],
+        "faults": ["on" if f else "off" for f in fault_variants],
+    }
+    return report
+
+
+def purity_verdict(traced, name: str = "program") -> Dict[str, Any]:
+    """Static purity verdict of one already-traced jitted program — the
+    cross-check tools/trip_profile.py runs against its RUNTIME dispatch
+    count (static "no callbacks" must agree with measured "+0 syncs")."""
+    prog = program_from_traced(traced, name=name, kind=name)
+    vs = rules_mod.PurityRule().check(prog)
+    return {
+        "pure": not vs,
+        "violations": [v.to_dict() for v in vs],
+    }

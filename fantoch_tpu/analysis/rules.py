@@ -1,0 +1,456 @@
+"""Static contract rules over traced engine jaxprs.
+
+Each rule is a small class with a stable ``id`` and a
+``check(program) -> [Violation]`` method; ``Program`` (analysis/checker.py)
+carries everything a rule may inspect — the closed jaxpr, per-leaf
+input/output avals with pytree paths, donation flags, the SimSpec, and the
+static objects that act as recompile keys. Rules never execute or compile
+anything: they walk the jaxpr the way the model checker walks protocol
+states, so a violation is caught at trace time, on every protocol, in CI,
+without running the simulation.
+
+The rule set is the static form of the engine contract
+(engine/lockstep.py ENGINE_CONTRACT comment):
+
+- ``purity``     — no host callbacks / host transfers inside a jitted
+                   region (the static form of tools/trip_profile.py's
+                   "+0 host syncs" runtime guarantee);
+- ``dtype``      — no 64-bit widening anywhere, state-schema stability
+                   (every state leaf leaves the program with the dtype and
+                   weak-type it entered with), and overflow headroom for
+                   the int32 monotone counters feeding trace diffs;
+- ``donation``   — every donated buffer is alias-eligible (shape/dtype
+                   matched to a distinct output leaf, so XLA can update it
+                   in place) — the static side of the contracts pinned in
+                   tests/test_sweep_megachunk.py;
+- ``static-keys``— every object used as a static recompile key is hashable
+                   and ``__eq__``/``hash``/``repr``-stable, and retracing a
+                   program under the same key yields the same jaxpr
+                   signature (an unstable trace is an avoidable recompile).
+"""
+from __future__ import annotations
+
+import copy
+import dataclasses
+import hashlib
+from typing import Any, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+try:  # jax.core keeps these public-but-deprecated; fall back if removed
+    from jax.core import ClosedJaxpr, Jaxpr
+except ImportError:  # pragma: no cover
+    from jax._src.core import ClosedJaxpr, Jaxpr
+
+
+# ---------------------------------------------------------------------------
+# violations
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One rule violation, locatable down to the jaxpr equation or leaf."""
+
+    rule: str  # rule id, e.g. "purity/callback"
+    program: str  # program display name
+    path: str  # jaxpr path ("jaxpr/while[3].body_jaxpr") or leaf path
+    primitive: str  # offending primitive (or "" for leaf/key violations)
+    detail: str
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def __str__(self) -> str:
+        where = self.path + (f" :: {self.primitive}" if self.primitive else "")
+        return f"[{self.rule}] {self.program} @ {where}: {self.detail}"
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking
+# ---------------------------------------------------------------------------
+
+
+def _sub_jaxprs(eqn) -> Iterator[Tuple[str, Jaxpr]]:
+    """Every sub-jaxpr of one equation, by param name (covers while's
+    cond/body, cond's branches, scan/pjit/shard_map/custom-call jaxprs —
+    anything that stores a Jaxpr or ClosedJaxpr in its params)."""
+    for name, val in eqn.params.items():
+        vals = val if isinstance(val, (list, tuple)) else (val,)
+        for i, v in enumerate(vals):
+            tag = name if len(vals) == 1 else f"{name}[{i}]"
+            if isinstance(v, ClosedJaxpr):
+                yield tag, v.jaxpr
+            elif isinstance(v, Jaxpr):
+                yield tag, v
+
+
+def walk(jaxpr: Jaxpr, path: str = "jaxpr") -> Iterator[Tuple[str, Any]]:
+    """Yield ``(path, eqn)`` for every equation in `jaxpr`, recursing into
+    all sub-jaxprs (`while`/`cond`/`scan`/`pjit`/`shard_map`/...)."""
+    for i, eqn in enumerate(jaxpr.eqns):
+        yield path, eqn
+        for tag, sub in _sub_jaxprs(eqn):
+            yield from walk(sub, f"{path}/{eqn.primitive.name}[{i}].{tag}")
+
+
+def _stable_repr(v) -> str:
+    """repr for hashable param values; anything whose repr could embed an
+    object address (functions, trace machinery) degrades to its type
+    name."""
+    if isinstance(v, (int, float, str, bool, bytes, type(None), np.dtype)):
+        return repr(v)
+    if isinstance(v, (tuple, list)):
+        return "(" + ",".join(_stable_repr(x) for x in v) + ")"
+    r = repr(v)
+    return r if "0x" not in r else type(v).__name__
+
+
+def jaxpr_signature(closed: ClosedJaxpr, in_avals: Sequence[Any]) -> str:
+    """Stable STRUCTURAL hash of a traced program: primitive sequence,
+    in/out avals (literals by value) and simple params, recursing into
+    every sub-jaxpr. Two traces of the same (spec, protocol, workload) key
+    must produce the same signature — a differing signature under the same
+    key is an avoidable recompile.
+
+    Deliberately NOT a hash of the pretty-printed jaxpr: the printer
+    hoists `let name = {...}` bindings for sub-jaxprs that happen to be
+    SHARED Python objects, and that sharing depends on jax's internal
+    tracing caches (which other programs were traced first in the same
+    process) — identical programs would hash differently. Params that are
+    functions/trace machinery hash by type name only, for the same
+    reason."""
+    h = hashlib.sha1()
+
+    def feed(s: str):
+        h.update(s.encode())
+        h.update(b"\x00")
+
+    def vstr(v) -> str:
+        # Literals by value; Vars by aval only (names are trace-order noise)
+        if hasattr(v, "val"):
+            return f"lit:{v.val!r}:{getattr(v, 'aval', '')}"
+        return str(getattr(v, "aval", v))
+
+    def walk_j(j: Jaxpr):
+        feed("in:" + ";".join(str(v.aval) for v in j.invars))
+        feed("const:" + ";".join(str(v.aval) for v in j.constvars))
+        for eqn in j.eqns:
+            feed(eqn.primitive.name)
+            feed(";".join(vstr(v) for v in eqn.invars))
+            feed(";".join(str(v.aval) for v in eqn.outvars))
+            for k in sorted(eqn.params):
+                v = eqn.params[k]
+                vals = v if isinstance(v, (list, tuple)) else (v,)
+                if any(isinstance(x, (ClosedJaxpr, Jaxpr)) for x in vals):
+                    feed(k)
+                    for x in vals:
+                        if isinstance(x, ClosedJaxpr):
+                            walk_j(x.jaxpr)
+                        elif isinstance(x, Jaxpr):
+                            walk_j(x)
+                else:
+                    feed(f"{k}={_stable_repr(v)}")
+        feed("out:" + ";".join(vstr(v) for v in j.outvars))
+
+    walk_j(closed.jaxpr)
+    h.update(repr([str(a) for a in in_avals]).encode())
+    return h.hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# leaf records (filled by checker.Program)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Leaf:
+    """One flattened pytree leaf with its path and aval."""
+
+    path: str  # jax.tree_util.keystr of the leaf
+    shape: Tuple[int, ...]
+    dtype: str
+    weak_type: bool = False
+    donated: bool = False
+
+
+def _leaf_name(path: str) -> str:
+    """Trailing attribute of a keystr path ('[1].proto.clocks' -> 'clocks')."""
+    return path.rsplit(".", 1)[-1].strip("[]'\"")
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+# host-callback primitives: any of these inside a jitted region is a host
+# round-trip per execution — the exact failure mode the megachunk driver
+# exists to remove (one int8 sync per k chunks)
+CALLBACK_PRIMS = frozenset({
+    "pure_callback",
+    "io_callback",
+    "debug_callback",
+    "callback",
+    "outside_call",
+    "host_callback_call",
+})
+# host-stream primitives. NOTE: `device_put` is deliberately NOT banned —
+# inside a jitted region it is a placement directive compiled into the
+# program (jnp.asarray on a constant, a sharding hint), not a host round
+# trip; tools/trip_profile.py's runtime dispatch counts confirm the
+# protocol programs that contain it run at +0 host syncs, and the static
+# verdict must agree with that measurement.
+TRANSFER_PRIMS = frozenset({"infeed", "outfeed"})
+
+# 64-bit dtypes: the engine is int32-only by contract (dense one-hot ops,
+# packed tie keys and histogram math all assume it); a single widened leaf
+# doubles its memory traffic and silently changes overflow semantics
+WIDE_DTYPES = frozenset({"int64", "uint64", "float64", "complex128"})
+
+# monotone int32 counters that feed trace diffs (obs/trace.py
+# counter_snapshot) or bound loop progress: these must be exactly int32 and
+# must keep multiplicative headroom against max_steps (each grows at most a
+# small per-trip constant, so 8x headroom on the step bound keeps every
+# counter far from wrap)
+MONOTONE_COUNTERS = frozenset({
+    "step", "iters", "seqno", "next_seq", "c_issued", "c_resp", "lat_cnt",
+    "commit_count", "fast_count", "slow_count", "executed_count",
+})
+COUNTER_HEADROOM = 8
+
+
+class PurityRule:
+    """No host callbacks or host transfers anywhere in a jitted region."""
+
+    id = "purity"
+
+    def check(self, program) -> List[Violation]:
+        out: List[Violation] = []
+        for path, eqn in walk(program.jaxpr.jaxpr):
+            name = eqn.primitive.name
+            if name in CALLBACK_PRIMS:
+                out.append(Violation(
+                    rule="purity/callback", program=program.name, path=path,
+                    primitive=name,
+                    detail="host callback inside a jitted region (adds a"
+                           " host round-trip per execution; the engine"
+                           " contract is zero host syncs per megachunk)",
+                ))
+            elif name in TRANSFER_PRIMS:
+                out.append(Violation(
+                    rule="purity/transfer", program=program.name, path=path,
+                    primitive=name,
+                    detail="host/device transfer primitive inside a jitted"
+                           " region",
+                ))
+        return out
+
+
+class DtypeRule:
+    """64-bit widening, state-schema drift, counter overflow headroom."""
+
+    id = "dtype"
+
+    def check(self, program) -> List[Violation]:
+        out: List[Violation] = []
+        # (a) wide dtypes anywhere in the traced program: program inputs
+        # and closure constants (a 64-bit buffer narrowed on first use
+        # never shows up as an eqn OUTPUT but still rides device memory)
+        # plus every equation result, sub-jaxprs included
+        top = program.jaxpr.jaxpr
+        for role, vs in (("invars", top.invars), ("constvars", top.constvars)):
+            for v in vs:
+                dt = getattr(getattr(v, "aval", None), "dtype", None)
+                if dt is not None and str(dt) in WIDE_DTYPES:
+                    out.append(Violation(
+                        rule="dtype/wide", program=program.name,
+                        path=f"jaxpr.{role}", primitive="",
+                        detail=f"program {role[:-1]} carries {dt} (the"
+                               " engine is 32-bit by contract)",
+                    ))
+        for path, eqn in walk(top):
+            for v in eqn.outvars:
+                dt = getattr(getattr(v, "aval", None), "dtype", None)
+                if dt is not None and str(dt) in WIDE_DTYPES:
+                    out.append(Violation(
+                        rule="dtype/wide", program=program.name, path=path,
+                        primitive=eqn.primitive.name,
+                        detail=f"{eqn.primitive.name} produces {dt} (the"
+                               " engine is 32-bit by contract)",
+                    ))
+                    break  # one report per equation is enough
+        # (b) state-schema stability: every output state leaf must leave
+        # with the dtype/weak-type it entered with (schema derived from the
+        # engine's own declared pytree — the input state avals)
+        schema = {lf.path: lf for lf in program.state_in}
+        for lf in program.state_out:
+            ref = schema.get(lf.path)
+            if ref is None:
+                continue  # new leaf (e.g. a returned done flag) — not state
+            if lf.dtype != ref.dtype or lf.weak_type != ref.weak_type:
+                out.append(Violation(
+                    rule="dtype/state-schema", program=program.name,
+                    path=lf.path, primitive="",
+                    detail=f"state leaf widened: in {ref.dtype}"
+                           f"{'(weak)' if ref.weak_type else ''} -> out "
+                           f"{lf.dtype}{'(weak)' if lf.weak_type else ''}",
+                ))
+        # (c) counter discipline + overflow headroom
+        for lf in program.state_in:
+            if _leaf_name(lf.path) in MONOTONE_COUNTERS and lf.dtype != "int32":
+                out.append(Violation(
+                    rule="dtype/counter", program=program.name,
+                    path=lf.path, primitive="",
+                    detail=f"monotone counter is {lf.dtype}, must be int32"
+                           " (trace diffs and overflow audits assume it)",
+                ))
+        spec = program.spec
+        max_steps = getattr(spec, "max_steps", None) if spec is not None else None
+        if max_steps is not None and \
+                max_steps > (2**31 - 1) // COUNTER_HEADROOM:
+            out.append(Violation(
+                rule="dtype/overflow-headroom", program=program.name,
+                path="spec.max_steps", primitive="",
+                detail=f"max_steps={max_steps} leaves <{COUNTER_HEADROOM}x"
+                       " int32 headroom for monotone counters that grow a"
+                       " small constant per trip",
+            ))
+        return out
+
+
+class DonationRule:
+    """Every donated buffer must be alias-eligible: shape/dtype-matched to
+    a DISTINCT output leaf (greedy multiset matching — two donated leaves
+    can never claim the same output slot, the static form of "no donated
+    leaf is consumed twice")."""
+
+    id = "donation"
+
+    def check(self, program) -> List[Violation]:
+        out: List[Violation] = []
+        donated = [lf for lf in program.args if lf.donated]
+        if program.forbid_donation and donated:
+            out.append(Violation(
+                rule="donation/forbidden", program=program.name,
+                path=donated[0].path, primitive="",
+                detail=f"{len(donated)} leaf(s) donated on a non-donating"
+                       " driver — the checkpointing contract requires the"
+                       " input state to stay readable after the call"
+                       " (tests/test_sweep_megachunk.py)",
+            ))
+        if program.expect_donation and not donated:
+            out.append(Violation(
+                rule="donation/missing", program=program.name,
+                path="donate_argnums", primitive="",
+                detail="driver is expected to donate its state argument"
+                       " but no input leaf is marked donated",
+            ))
+        # multiset of output slots by (shape, dtype)
+        slots: dict = {}
+        for lf in program.outs:
+            slots.setdefault((lf.shape, lf.dtype), []).append(lf.path)
+        for lf in donated:
+            bucket = slots.get((lf.shape, lf.dtype))
+            if bucket:
+                bucket.pop()  # claim one output slot — never reused
+            else:
+                out.append(Violation(
+                    rule="donation/alias", program=program.name,
+                    path=lf.path, primitive="",
+                    detail=f"donated leaf {lf.dtype}{list(lf.shape)} has no"
+                           " unclaimed shape/dtype-matched output — XLA"
+                           " cannot alias it, the donation is wasted (or a"
+                           " second donated leaf already consumed the only"
+                           " matching output)",
+                ))
+        return out
+
+
+class StaticKeyRule:
+    """Recompile-key hygiene for the static objects reaching jit
+    boundaries (SimSpec, TraceSpec, workload constants): hashable,
+    ``__eq__``-stable against a deep copy, hash-stable across calls, repr-
+    deterministic (the conftest/harness cache keys use ``repr(wl)``)."""
+
+    id = "static-keys"
+
+    def check(self, program) -> List[Violation]:
+        out: List[Violation] = []
+        for name, obj, mode in program.statics:
+            if obj is None:
+                continue
+            if mode == "hash":
+                try:
+                    h1, h2 = hash(obj), hash(obj)
+                except TypeError as e:
+                    out.append(Violation(
+                        rule="static-keys/unhashable", program=program.name,
+                        path=name, primitive="",
+                        detail=f"static recompile key is unhashable: {e}",
+                    ))
+                    continue
+                if h1 != h2:
+                    out.append(Violation(
+                        rule="static-keys/hash-unstable",
+                        program=program.name, path=name, primitive="",
+                        detail="hash() differs across two calls on the"
+                               " same object",
+                    ))
+                    continue
+                try:
+                    clone = copy.deepcopy(obj)
+                except Exception as e:  # noqa: BLE001
+                    out.append(Violation(
+                        rule="static-keys/uncopyable", program=program.name,
+                        path=name, primitive="",
+                        detail=f"cannot deep-copy static key: {e}",
+                    ))
+                    continue
+                if clone != obj or hash(clone) != h1:
+                    out.append(Violation(
+                        rule="static-keys/eq-unstable", program=program.name,
+                        path=name, primitive="",
+                        detail="a structurally-equal copy is != or hashes"
+                               " differently — every such object is a"
+                               " spurious recompile",
+                    ))
+            else:  # mode == "repr": identity-by-repr keys (Workload)
+                r1 = repr(obj)
+                try:
+                    r2 = repr(copy.deepcopy(obj))
+                except Exception as e:  # noqa: BLE001
+                    out.append(Violation(
+                        rule="static-keys/uncopyable", program=program.name,
+                        path=name, primitive="",
+                        detail=f"cannot deep-copy repr key: {e}",
+                    ))
+                    continue
+                if r1 != r2 or "0x" in r1:
+                    out.append(Violation(
+                        rule="static-keys/repr-unstable",
+                        program=program.name, path=name, primitive="",
+                        detail="repr() is not structural (differs for an"
+                               " equal copy or embeds an object address) —"
+                               " cache keys built from it recompile every"
+                               " session",
+                    ))
+        return out
+
+
+def check_trace_stability(program, retraced_signature: str) -> List[Violation]:
+    """Same compile key, different jaxpr -> an avoidable recompile (e.g.
+    a trace that bakes in a Python object id, an env var read mid-trace, a
+    fresh closure constant). `retraced_signature` comes from tracing the
+    SAME program a second time."""
+    if program.signature == retraced_signature:
+        return []
+    return [Violation(
+        rule="static-keys/trace-unstable", program=program.name,
+        path="jaxpr", primitive="",
+        detail=f"retracing under the same key produced a different jaxpr"
+               f" ({program.signature} != {retraced_signature}) — every"
+               " cache lookup misses and recompiles",
+    )]
+
+
+ALL_RULES = (PurityRule(), DtypeRule(), DonationRule(), StaticKeyRule())

@@ -87,6 +87,41 @@ _BIG = jnp.int32(2**30)
 # the A/B equality suite proves unobservable do NOT bump it.
 ENGINE_CONTRACT = 5  # 5: partition windows feed the perfect failure
 # detector (dynamic quorum masks avoid cross-cut peers; engine/faults.py)
+#
+# Engine invariants, by HOW each is enforced (`python -m fantoch_tpu lint`
+# is the static checker, fantoch_tpu/analysis):
+#
+#   STATICALLY checked — at trace time, every protocol x engine x
+#   trace/faults variant, in CI, without running a simulation:
+#     * purity: no host callbacks (io/pure/debug_callback) or transfer
+#       primitives anywhere in a jitted region, sub-jaxprs included — the
+#       static form of trip_profile's "+0 host syncs" guarantee
+#       (FANTOCH_DEBUG_TRIPS deliberately violates this; never time it);
+#     * dtype discipline: no 64-bit widening anywhere, every SimState/
+#       RState leaf leaves run_chunk/run_megachunk/run_sharded with the
+#       dtype + weak-type it entered with, monotone counters (step, seqno,
+#       next_seq, c_issued, lat_cnt, *_count) are exactly int32 with >= 8x
+#       max_steps overflow headroom;
+#     * donation safety: every donated state leaf is alias-eligible — a
+#       distinct shape/dtype-matched output exists for XLA to alias, no
+#       two donated leaves claim one output;
+#     * recompile keys: SimSpec/TraceSpec are hashable and __eq__/hash-
+#       stable, workload reprs are structural, and retracing under the
+#       same key reproduces the jaxpr signature bit-for-bit.
+#   RUNTIME checked:
+#     * megachunk host-sync count (tools/trip_profile.py --drivers fails
+#       hard on any extra dispatch AND on disagreement with the static
+#       purity verdict);
+#     * dropped == 0 pool-capacity contract (summary.check_sim_health);
+#     * donation deletion/snapshot semantics + megachunk bit-identity
+#       (tests/test_sweep_megachunk.py), trace-on/off bit-identity
+#       (tests/test_trace.py), bench stall watchdog (bench.py reads the
+#       run's own done channel).
+#   CONVENTION (reviewed, pinned by equality suites, not checked per se):
+#     * handlers are row-local (Ctx docstring, engine/types.py) — the
+#       property the distributed runner's sharding relies on;
+#     * scheduling changes must be proved observable-equivalent by the
+#       A/B + native-oracle suites before NOT bumping ENGINE_CONTRACT.
 
 
 @dataclasses.dataclass(frozen=True)
@@ -2404,6 +2439,14 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             any_deliv, st_d, _tree_select(any_due, st_p, st_e)
         )
 
+    # opt-in per-trip debug printing: a development aid for watching a
+    # wedged run live from inside the jitted loop. Deliberately IMPURE (a
+    # host callback per trip) — the static contract checker
+    # (fantoch_tpu/analysis, `python -m fantoch_tpu lint`) flags any build
+    # compiled with it, and its negative tests seed it as the engine-level
+    # purity violation. Never leave it on for timed runs.
+    DEBUG_TRIPS = os.environ.get("FANTOCH_DEBUG_TRIPS") == "1"
+
     def _body_for(env: Env):
         # the workload tables are loop-invariant: traced HERE (outside the
         # while loop), they become invariant operands of the while op — the
@@ -2414,6 +2457,15 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             fn = functools.partial(_fast_round, env, aux, wl_tabs)
         else:
             fn = functools.partial(body, env, wl_tabs)
+        if DEBUG_TRIPS:
+            inner = fn
+
+            def fn(st: SimState) -> SimState:
+                jax.debug.print(
+                    "trip step={s} now={t}", s=st.step, t=st.now
+                )
+                return inner(st)
+
         if TR is None:
             return fn
 

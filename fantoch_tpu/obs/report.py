@@ -47,6 +47,87 @@ def stall_stats(per_window: Sequence[float], window_ms: int) -> Dict[str, Any]:
     }
 
 
+def live_stall_gap_ms(per_window: Sequence[float], now_ms: int,
+                      window_ms: int) -> float:
+    """Silence between the last active window and the CURRENT sim instant.
+
+    The live-run counterpart of `stall_stats`: trailing silence COUNTS
+    here, because "no completions since window k while the clock kept
+    advancing" is exactly what a wedged run looks like from its own trace
+    (the bench watchdog's abort signal).
+
+    Past the trace horizon the recorder bins every completion into the
+    final window, so that window's activity is time-ambiguous: if it is
+    ACTIVE the gap is indeterminate and reported as 0 (never a false
+    abort of a healthy long run); if it is SILENT, completions provably
+    stopped inside the horizon and the true gap keeps growing with the
+    real clock — the watchdog must not freeze at the horizon edge."""
+    arr = np.asarray(per_window)
+    now = int(now_ms)
+    cur_w = max(0, min(len(arr) - 1, now // window_ms))
+    active = np.nonzero(arr[:cur_w + 1] > 0)[0]
+    last = int(active[-1]) if len(active) else -1
+    if now >= len(arr) * window_ms:
+        if last == len(arr) - 1:
+            return 0.0
+        return float(now - (last + 1) * window_ms)
+    return float((cur_w - last) * window_ms)
+
+
+def diff_reports(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
+    """Compare two drained trace reports window-by-window.
+
+    For every channel present in either report: per-window deltas (B - A,
+    padded to the longer series), totals, and the FIRST divergence window
+    (index + ms). The overall `first_divergence` is the earliest divergence
+    across channels — where two runs' timelines split, which is where a
+    schedule/seed/fault difference first became observable."""
+    for tag, rep in (("A", a), ("B", b)):
+        if not isinstance(rep.get("window_ms"), int) \
+                or rep["window_ms"] <= 0 \
+                or not isinstance(rep.get("channels"), dict):
+            raise ValueError(
+                f"report {tag} is not a drained trace report (needs"
+                " integer window_ms + channels dict — the output of"
+                " obs/report.drain / `trace --json`)"
+            )
+    wm = a["window_ms"]
+    if wm != b["window_ms"]:
+        raise ValueError(
+            f"window_ms differs ({wm} vs {b['window_ms']}) — rebin"
+            " before diffing"
+        )
+    cha, chb = a.get("channels", {}), b.get("channels", {})
+    out_ch: Dict[str, Any] = {}
+    first: Optional[Dict[str, Any]] = None
+    for name in sorted(set(cha) | set(chb)):
+        pa = list(cha.get(name, {}).get("per_window", []))
+        pb = list(chb.get(name, {}).get("per_window", []))
+        n = max(len(pa), len(pb))
+        pa += [0] * (n - len(pa))
+        pb += [0] * (n - len(pb))
+        delta = [y - x for x, y in zip(pa, pb)]
+        div = next((i for i, d in enumerate(delta) if d != 0), None)
+        rec = {
+            "total_a": int(sum(pa)),
+            "total_b": int(sum(pb)),
+            "delta_total": int(sum(delta)),
+            "delta_per_window": delta,
+            "max_abs_delta": int(max((abs(d) for d in delta), default=0)),
+            "first_divergence_window": div,
+            "first_divergence_ms": None if div is None else div * wm,
+        }
+        out_ch[name] = rec
+        if div is not None and (first is None or div < first["window"]):
+            first = {"channel": name, "window": div, "ms": div * wm}
+    return {
+        "window_ms": wm,
+        "channels": out_ch,
+        "identical": first is None,
+        "first_divergence": first,
+    }
+
+
 def drain(
     st,
     tspec: TraceSpec,

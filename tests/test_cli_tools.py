@@ -95,6 +95,47 @@ def test_cli_trace_subcommand(capsys, tmp_path):
         assert "| done |" in f.read()
 
 
+def test_cli_trace_diff_subcommand(capsys, tmp_path):
+    """`trace --json` persists a drained report; `trace --diff A B`
+    compares two saved timelines: per-channel window deltas + the first-
+    divergence window. Two runs of different lengths diverge; a report
+    against itself is identical."""
+    paths = {}
+    for cmds in (4, 6):
+        p = str(tmp_path / f"rep{cmds}.json")
+        rc = main([
+            "trace", "--protocol", "basic", "--n", "3", "--f", "1",
+            "--clients", "1", "--commands", str(cmds), "--conflict", "100",
+            "--window", "50", "--windows", "64",
+            "--json", p,
+        ])
+        assert rc == 0
+        capsys.readouterr()
+        paths[cmds] = p
+
+    # the documented invocation: --diff needs no --protocol
+    rc = main(["trace", "--diff", paths[4], paths[6]])
+    assert rc == 0
+    d = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert d["window_ms"] == 50
+    assert "done" in d["channels"]
+    assert not d["identical"], "4- vs 6-command runs must diverge"
+    # the longer run completes 4 extra commands (2 regions x 2 commands)
+    assert d["channels"]["done"]["delta_total"] == 4
+    fd = d["first_divergence"]
+    assert fd["channel"] in d["channels"]
+    assert d["channels"][fd["channel"]]["first_divergence_window"] \
+        == fd["window"]
+    assert fd["ms"] == fd["window"] * 50
+
+    rc = main(["trace", "--diff", paths[4], paths[4]])
+    assert rc == 0
+    d0 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert d0["identical"] and d0["first_divergence"] is None
+    assert all(ch["first_divergence_window"] is None
+               for ch in d0["channels"].values())
+
+
 def test_cli_shard_distribution(capsys):
     rc = main(
         [

@@ -1,0 +1,358 @@
+"""Static engine-contract checker (fantoch_tpu/analysis).
+
+Two halves, both required:
+
+- POSITIVE: the real engine programs lint clean. The default tier checks a
+  fast subset (basic across all three engines + a leader protocol); the
+  full six-protocol x trace x faults matrix is the slow tier and the
+  `python -m fantoch_tpu lint` CLI acceptance run.
+- NEGATIVE: every rule must DETECT a seeded violation — a debug_print in a
+  step body, an int64 literal, an unaliasable donation, a non-hashable
+  spec. A checker that has never seen a violation is untested. Each
+  negative asserts the report carries the rule id AND the jaxpr/leaf path.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fantoch_tpu.analysis import checker, rules
+
+
+# ---------------------------------------------------------------------------
+# positive: the real engine programs are clean
+# ---------------------------------------------------------------------------
+
+
+def test_lint_clean_fast_subset():
+    """basic through all three engines (trace/faults variants included for
+    lockstep) plus one leader protocol — the tier-1 face of the full
+    matrix."""
+    programs, skips = checker.build_matrix(
+        ["basic"], ["lockstep", "sweep"], (False, True), (False, True)
+    )
+    programs += checker.lockstep_programs("fpaxos", trace=True, faults=None)
+    programs += checker.quantum_programs("basic", trace=True, faults=None)
+    assert not skips
+    report = checker.run_check(programs)
+    assert report["violations"] == [], report["violations"]
+    assert report["ok"]
+    # the matrix actually covered what it claims: donating drivers donated,
+    # the non-donating chunked runner did not
+    by_kind = {}
+    for p in report["programs"]:
+        by_kind.setdefault(p["name"].split("[")[0], []).append(p)
+    assert by_kind["lockstep.run_chunk"][0]["donated_leaves"] > 0
+    assert by_kind["sweep.megachunk"][0]["donated_leaves"] > 0
+    assert by_kind["sweep.chunked(donate=False)"][0]["donated_leaves"] == 0
+    assert by_kind["quantum.run_sharded"][0]["eqns"] > 1000
+    # the dtype-schema rule compared real state leaves on EVERY engine
+    # program (0 = the check went vacuous, a path-normalization bug)
+    for kind, recs in by_kind.items():
+        for rec in recs:
+            assert rec["schema_leaves"] >= 50, (kind, rec["schema_leaves"])
+
+
+@pytest.mark.slow
+def test_lint_full_matrix_clean():
+    """All six protocols x all engines x trace-on/off x fault-on/off (the
+    CLI acceptance criterion, in-process)."""
+    report = checker.lint()
+    assert report["skipped"] == []
+    assert report["violations"] == [], report["violations"]
+    # 6 protocols x (2 trace x 2 faults x 2 lockstep programs
+    #   + 2 trace x 1 sweep mega + 2 trace x 2 faults quantum)
+    #   + basic's non-donating chunked runner per trace variant
+    assert len(report["programs"]) == 6 * (8 + 2 + 4) + 2
+
+
+# ---------------------------------------------------------------------------
+# negative: purity
+# ---------------------------------------------------------------------------
+
+
+def test_purity_flags_debug_print_in_while_body():
+    def bad(x):
+        def body(c):
+            jax.debug.print("c={c}", c=c)
+            return c + 1
+
+        return jax.lax.while_loop(lambda c: c < x, body, jnp.int32(0))
+
+    prog = checker.program_from_traced(
+        jax.jit(bad).trace(jnp.int32(5)), name="toy.debug", kind="toy"
+    )
+    vs = rules.PurityRule().check(prog)
+    assert len(vs) == 1
+    assert vs[0].rule == "purity/callback"
+    assert vs[0].primitive == "debug_callback"
+    assert "while" in vs[0].path and "body" in vs[0].path  # jaxpr path
+
+
+def test_purity_flags_pure_callback():
+    def bad(x):
+        return jax.pure_callback(
+            lambda v: np.asarray(v) * 2,
+            jax.ShapeDtypeStruct((), jnp.int32), x
+        )
+
+    prog = checker.program_from_traced(
+        jax.jit(bad).trace(jnp.int32(3)), name="toy.cb", kind="toy"
+    )
+    vs = rules.PurityRule().check(prog)
+    assert [v.primitive for v in vs] == ["pure_callback"]
+
+
+def test_purity_flags_seeded_engine_debug_trips(monkeypatch):
+    """The end-to-end seeded violation: FANTOCH_DEBUG_TRIPS=1 compiles a
+    per-trip debug_print into the REAL engine step body; the checker must
+    flag it inside the while loop of both lockstep drivers, with the rule
+    id and the jaxpr path in the report."""
+    monkeypatch.setenv("FANTOCH_DEBUG_TRIPS", "1")
+    programs = checker.lockstep_programs("basic", trace=False, faults=None)
+    report = checker.run_check(programs, retrace=False)
+    assert not report["ok"]
+    flagged = {v["program"].split("[")[0] for v in report["violations"]}
+    assert flagged == {"lockstep.run_chunk", "lockstep.run_megachunk"}
+    for v in report["violations"]:
+        assert v["rule"] == "purity/callback"
+        assert v["primitive"] == "debug_callback"
+        assert "while" in v["path"]  # it is INSIDE the loop body
+
+
+# ---------------------------------------------------------------------------
+# negative: dtype discipline
+# ---------------------------------------------------------------------------
+
+
+def test_dtype_flags_int64_widening():
+    with jax.experimental.enable_x64(True):
+        def bad(x):
+            return x.astype(jnp.int64) + 1
+
+        traced = jax.jit(bad).trace(jnp.arange(3, dtype=jnp.int32))
+    prog = checker.program_from_traced(traced, name="toy.wide", kind="toy")
+    vs = [v for v in rules.DtypeRule().check(prog) if v.rule == "dtype/wide"]
+    assert vs, "int64 widening not flagged"
+    assert "int64" in vs[0].detail
+
+
+def test_dtype_flags_int64_input_narrowed_on_first_use():
+    """A 64-bit buffer that enters the program and is immediately narrowed
+    never appears as an eqn OUTPUT — but it still rides device memory, so
+    the invar scan must flag it."""
+    with jax.experimental.enable_x64(True):
+        traced = jax.jit(lambda x: x.astype(jnp.int32) + 1).trace(
+            jnp.arange(3, dtype=jnp.int64)
+        )
+    prog = checker.program_from_traced(traced, name="toy.wide-in", kind="toy")
+    vs = [v for v in rules.DtypeRule().check(prog) if v.rule == "dtype/wide"]
+    assert vs, "int64 program input not flagged"
+    assert vs[0].path == "jaxpr.invars" and "int64" in vs[0].detail
+
+
+def test_dtype_flags_state_schema_drift():
+    """A chunk-shaped fn whose output state leaf silently changes dtype
+    (int32 -> float32) must be flagged by leaf name."""
+    def bad(env, st):
+        return {"now": st["now"].astype(jnp.float32), "step": st["step"] + 1}
+
+    st = {"now": jnp.int32(0), "step": jnp.int32(0)}
+    traced = jax.jit(bad).trace(jnp.zeros((3,), jnp.int32), st)
+    prog = checker.program_from_traced(
+        traced, name="toy.schema", kind="toy",
+        state_in_prefix="[1]", state_out_prefix="",
+    )
+    vs = [v for v in rules.DtypeRule().check(prog)
+          if v.rule == "dtype/state-schema"]
+    assert len(vs) == 1
+    assert "now" in vs[0].path
+    assert "int32" in vs[0].detail and "float32" in vs[0].detail
+
+
+def test_dtype_flags_counter_dtype_and_headroom():
+    def ident(st):
+        return st
+
+    st = {"step": jnp.int16(0), "now": jnp.int32(0)}
+    traced = jax.jit(ident).trace(st)
+    prog = checker.program_from_traced(
+        traced, name="toy.counter", kind="toy",
+        state_in_prefix="[0]", state_out_prefix="",
+    )
+    vs = {v.rule for v in rules.DtypeRule().check(prog)}
+    assert "dtype/counter" in vs  # int16 step
+
+    # overflow headroom: a spec whose max_steps leaves <8x int32 headroom
+    prog2 = dataclasses.replace(
+        checker.program_from_traced(
+            jax.jit(lambda x: x).trace(jnp.int32(0)),
+            name="toy.headroom", kind="toy",
+        ),
+    )
+    class _Spec:
+        max_steps = 2**29
+    prog2.spec = _Spec()
+    vs2 = [v for v in rules.DtypeRule().check(prog2)
+           if v.rule == "dtype/overflow-headroom"]
+    assert len(vs2) == 1 and "max_steps" in vs2[0].path
+
+
+# ---------------------------------------------------------------------------
+# negative: donation safety
+# ---------------------------------------------------------------------------
+
+
+def test_donation_flags_unaliasable_leaf():
+    """A donated buffer with no shape/dtype-matched output cannot be
+    aliased by XLA — the donation is wasted and must be flagged."""
+    def shrink(st):
+        return {"a": st["a"][:2]}  # [4] donated, only [2] comes out
+
+    traced = jax.jit(shrink, donate_argnums=(0,)).trace(
+        {"a": jnp.zeros((4,), jnp.int32)}
+    )
+    prog = checker.program_from_traced(
+        traced, name="toy.donate", kind="toy", expect_donation=True
+    )
+    vs = rules.DonationRule().check(prog)
+    assert len(vs) == 1
+    assert vs[0].rule == "donation/alias"
+    assert "'a'" in vs[0].path
+
+
+def test_donation_flags_double_consumption():
+    """Two donated leaves competing for ONE matching output slot: the
+    second consumption must be flagged (multiset matching — an output slot
+    is claimed at most once)."""
+    def merge(st):
+        return {"out": st["x"] + st["y"]}
+
+    traced = jax.jit(merge, donate_argnums=(0,)).trace(
+        {"x": jnp.zeros((3,), jnp.int32), "y": jnp.zeros((3,), jnp.int32)}
+    )
+    prog = checker.program_from_traced(
+        traced, name="toy.double", kind="toy", expect_donation=True
+    )
+    vs = rules.DonationRule().check(prog)
+    assert len(vs) == 1 and vs[0].rule == "donation/alias"
+
+
+def test_donation_flags_missing_expected_donation():
+    traced = jax.jit(lambda e, s: s).trace(jnp.int32(0), jnp.int32(1))
+    prog = checker.program_from_traced(
+        traced, name="toy.nodonate", kind="toy", expect_donation=True
+    )
+    vs = rules.DonationRule().check(prog)
+    assert [v.rule for v in vs] == ["donation/missing"]
+
+
+def test_donation_flags_forbidden_donation():
+    """The inverse contract: a driver pinned non-donating (the chunked
+    checkpointing path — the caller re-reads the input state after the
+    call) must be flagged if its state argument IS donated."""
+    traced = jax.jit(lambda s: s + 1, donate_argnums=(0,)).trace(
+        jnp.zeros((3,), jnp.int32)
+    )
+    prog = checker.program_from_traced(
+        traced, name="toy.forbid", kind="toy", forbid_donation=True
+    )
+    vs = rules.DonationRule().check(prog)
+    assert [v.rule for v in vs] == ["donation/forbidden"]
+
+
+# ---------------------------------------------------------------------------
+# negative: recompile-key hygiene
+# ---------------------------------------------------------------------------
+
+
+def _toy_program(**over):
+    traced = jax.jit(lambda x: x + 1).trace(jnp.int32(0))
+    prog = checker.program_from_traced(traced, name="toy.keys", kind="toy")
+    for k, v in over.items():
+        setattr(prog, k, v)
+    return prog
+
+
+def test_static_keys_flag_unhashable_spec():
+    """A SimSpec whose field holds a LIST (unhashable) breaks every compile
+    cache keyed on the spec — the exact seeded violation of the issue."""
+    spec, _pdef, _wl, _env, _tspec = checker.build_point("basic")
+    bad = dataclasses.replace(spec, proto_periodic_ms=[5, 10])  # list!
+    prog = _toy_program(statics=(("SimSpec", bad, "hash"),))
+    vs = rules.StaticKeyRule().check(prog)
+    assert [v.rule for v in vs] == ["static-keys/unhashable"]
+    assert vs[0].path == "SimSpec"
+
+
+def test_static_keys_flag_identity_eq_and_repr():
+    class IdKey:  # default __eq__/__hash__/__repr__: object identity
+        pass
+
+    prog = _toy_program(statics=(("IdKey", IdKey(), "hash"),))
+    vs = rules.StaticKeyRule().check(prog)
+    assert [v.rule for v in vs] == ["static-keys/eq-unstable"]
+
+    prog2 = _toy_program(statics=(("IdRepr", IdKey(), "repr"),))
+    vs2 = rules.StaticKeyRule().check(prog2)
+    assert [v.rule for v in vs2] == ["static-keys/repr-unstable"]
+
+
+def test_trace_instability_detected():
+    prog = _toy_program()
+    assert rules.check_trace_stability(prog, prog.signature) == []
+    vs = rules.check_trace_stability(prog, "deadbeefdeadbeef")
+    assert [v.rule for v in vs] == ["static-keys/trace-unstable"]
+
+
+def test_recompile_key_collision_across_programs():
+    """Two programs under the SAME compile key with different jaxprs: one
+    of them recompiles on every cache lookup — run_check must flag it."""
+    a = _toy_program()
+    traced_b = jax.jit(lambda x: x * 2 + 7).trace(jnp.int32(0))
+    b = checker.program_from_traced(traced_b, name="toy.keys2", kind="toy")
+    b.key = a.key
+    assert a.signature != b.signature
+    report = checker.run_check([a, b], retrace=False)
+    assert [v["rule"] for v in report["violations"]] \
+        == ["static-keys/key-collision"]
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def test_cli_lint_clean_and_seeded(capsys, monkeypatch):
+    """`python -m fantoch_tpu lint`: exit 0 + JSON report on a clean
+    subset; exit 1 with rule id + jaxpr path once the seeded engine
+    violation is compiled in."""
+    import json
+
+    from fantoch_tpu.__main__ import main
+
+    args = ["lint", "--protocols", "basic", "--engines", "lockstep",
+            "--trace", "off", "--faults", "off", "--no-retrace", "--json"]
+    rc = main(args)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert out["ok"] and out["violations"] == []
+    assert {p["name"].split("[")[0] for p in out["programs"]} \
+        == {"lockstep.run_chunk", "lockstep.run_megachunk"}
+
+    monkeypatch.setenv("FANTOCH_DEBUG_TRIPS", "1")
+    rc = main(args)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1
+    assert not out["ok"]
+    v = out["violations"][0]
+    assert v["rule"] == "purity/callback" and "while" in v["path"]
+
+    # a typo'd variant value must exit 2, not silently narrow the matrix
+    # to faults-off and report OK
+    rc = main(["lint", "--protocols", "basic", "--engines", "lockstep",
+               "--faults", "On"])
+    assert rc == 2
+    assert "on,off" in capsys.readouterr().err

@@ -56,6 +56,16 @@ def test_bench_smoke_all_six_protocols():
         assert tr["totals"]["commit"] > 0, (name, tr)
         assert tr["windows_active"] > 0, (name, tr)
 
+    # the static contract checker's digest rides the smoke aggregate (the
+    # CI face of `python -m fantoch_tpu lint`): a missing or failed digest
+    # would have forced the partial marker asserted absent above
+    lint = last.get("lint")
+    assert lint, "no lint digest in the smoke aggregate"
+    assert lint["ok"] is True and lint["violations"] == 0, lint
+    assert lint["programs"] > 0
+    assert set(lint["rules"]) == {"purity", "dtype", "donation",
+                                  "static-keys"}
+
     # incremental aggregates: at least one partial line must precede the
     # final one (the crash-containment property the round-4/5 benches
     # relied on to stay parseable under an external kill)

@@ -176,6 +176,49 @@ def test_trace_quantum_bit_identity_and_totals():
     assert int(tr["insert"].sum()) > 0
 
 
+@pytest.mark.parametrize("name", ["basic", "fpaxos"])
+def test_cross_engine_per_window_totals_equal(name):
+    """Lockstep vs quantum trace equality (ROADMAP follow-up): for the
+    time-deterministic channels — submit/issued/done (client-observable
+    instants) and commit (protocol commits at delivery instants) — the two
+    engines' per-window TOTALS are equal window for window. The
+    `insert`/`deliver` channels are engine-RELATIVE by construction (the
+    distributed runner replicates command records and client partials as
+    extra pool messages, and `deliver` counts its per-slot steps), so only
+    their positivity is asserted."""
+    from fantoch_tpu.parallel import quantum
+
+    leader = 1 if name == "fpaxos" else None
+    spec0, pdef, wl, env = _build(name, cmds=4, leader=leader)
+    spec = dataclasses.replace(spec0, trace=TSPEC)
+    st_l = _run(spec, pdef, wl, env)
+    assert bool(st_l.all_done)
+    r = quantum.build_runner(spec, pdef, wl, env)
+    st_q = jax.tree_util.tree_map(
+        np.asarray, r.run_sharded(quantum.make_mesh(3), r.init_state())
+    )
+    assert bool(st_q.all_done)
+    tr_l = {k: np.asarray(v) for k, v in st_l.trace.items()}
+    tr_q = {k: np.asarray(v) for k, v in st_q.trace.items()}
+
+    def lockstep_series(ch):  # [W, ...] -> [W]
+        a = tr_l[ch]
+        return a if a.ndim == 1 else a.reshape(a.shape[0], -1).sum(axis=1)
+
+    def quantum_series(ch):  # [n, W, ...] -> [W]
+        b = tr_q[ch]
+        b = b.sum(axis=0)
+        return b if b.ndim == 1 else b.reshape(b.shape[0], -1).sum(axis=1)
+
+    for ch in ("submit", "issued", "done", "commit"):
+        np.testing.assert_array_equal(
+            lockstep_series(ch), quantum_series(ch),
+            err_msg=f"per-window {ch} totals diverge across engines",
+        )
+    for ch in ("insert", "deliver"):
+        assert lockstep_series(ch).sum() > 0 and quantum_series(ch).sum() > 0
+
+
 def test_stall_detector_units():
     s = obs_report.stall_stats([0, 0, 3, 1, 0, 0, 0, 2, 0, 0], 100)
     # longest silence: windows 4-6 before the window-7 activity (4 windows
@@ -235,6 +278,93 @@ def test_trace_fault_timeline_shows_crash_dip_and_failover(tmp_path):
 
     out = plots.trace_timeline(rep, str(tmp_path / "trace.png"))
     assert os.path.exists(out)
+
+
+def test_live_stall_gap_units():
+    """The bench watchdog's live-run stall view: trailing silence COUNTS
+    (a wedged run is exactly "no completions while the clock advances"),
+    unlike stall_stats where a run that simply ended has no trailing
+    gap."""
+    # last activity in window 3, clock now in window 9 -> 6 windows silent
+    s = [0, 0, 3, 1, 0, 0, 0, 0, 0, 0]
+    assert obs_report.live_stall_gap_ms(s, 950, 100) == 600.0
+    # activity in the current window -> no gap
+    assert obs_report.live_stall_gap_ms([0, 2], 150, 100) == 0.0
+    # nothing ever completed: silence since t=0
+    assert obs_report.live_stall_gap_ms([0, 0, 0, 0], 350, 100) == 400.0
+    # clock past the trace horizon with the FINAL window silent: the true
+    # gap keeps growing with the real clock (the watchdog must not freeze
+    # at the horizon edge and go blind to late wedges)
+    assert obs_report.live_stall_gap_ms([5, 0, 0], 99_999, 100) == 99_899.0
+    # ... but post-horizon completions all bin into the final window, so
+    # an ACTIVE final window is time-ambiguous -> no gap (never a false
+    # abort of a healthy long run)
+    assert obs_report.live_stall_gap_ms([5, 0, 2], 99_999, 100) == 0.0
+
+
+def test_diff_reports_first_divergence():
+    """`trace --diff`'s core: per-channel window deltas + the first
+    window where two timelines split."""
+    a = {"window_ms": 100, "channels": {
+        "done": {"per_window": [2, 2, 2, 0]},
+        "submit": {"per_window": [4, 0, 0, 0]},
+    }}
+    b = {"window_ms": 100, "channels": {
+        "done": {"per_window": [2, 2, 0, 2]},
+        "submit": {"per_window": [4, 0, 0, 0]},
+    }}
+    d = obs_report.diff_reports(a, b)
+    assert d["identical"] is False
+    assert d["first_divergence"] == {"channel": "done", "window": 2,
+                                     "ms": 200}
+    ch = d["channels"]["done"]
+    assert ch["delta_per_window"] == [0, 0, -2, 2]
+    assert ch["total_a"] == 6 and ch["total_b"] == 6
+    assert ch["max_abs_delta"] == 2
+    assert d["channels"]["submit"]["first_divergence_window"] is None
+    # identity: a report diffed against itself is silent everywhere
+    d0 = obs_report.diff_reports(a, a)
+    assert d0["identical"] is True and d0["first_divergence"] is None
+    # ragged lengths pad with zeros rather than truncating a divergence
+    c = {"window_ms": 100, "channels": {"done": {"per_window": [2, 2]}}}
+    dc = obs_report.diff_reports(a, c)
+    assert dc["channels"]["done"]["first_divergence_window"] == 2
+    with pytest.raises(ValueError):
+        obs_report.diff_reports(a, {"window_ms": 50, "channels": {}})
+    # non-report operands (e.g. a bench aggregate passed by mistake) are a
+    # clean ValueError, not a silent "identical: true" or a TypeError
+    with pytest.raises(ValueError, match="not a drained trace report"):
+        obs_report.diff_reports({}, {})
+    with pytest.raises(ValueError, match="not a drained trace report"):
+        obs_report.diff_reports(a, {"events_per_sec": 123})
+
+
+def test_drain_horizon_clamped_by_final_time():
+    """Regression pin for the NOTE in CHANGES.md: a drained run leaves
+    `now=INF_TIME` (the loop advanced the clock past the last event), so
+    drain must clamp the report horizon by `final_time` — not report an
+    INF horizon or silently claim every window was used."""
+    from types import SimpleNamespace
+
+    from fantoch_tpu.engine.types import INF_TIME
+
+    W, wm = 32, 100
+    tspec = TraceSpec(window_ms=wm, max_windows=W)
+    done = np.zeros((W, 2), np.int32)
+    done[3, 0] = 5
+    st = SimpleNamespace(trace={"done": done}, now=np.int32(INF_TIME),
+                         final_time=np.int32(1234))
+    rep = obs_report.drain(st, tspec)
+    assert rep["horizon_ms"] == 1234
+    assert rep["windows_used"] == 1234 // wm + 1  # 13, not W
+    assert not rep["truncated"]
+    assert rep["channels"]["done"]["total"] == 5
+    # final_time ALSO unset (e.g. a deadline-stopped fault run drained at
+    # INF): fall back to the full trace span rather than a bogus INF
+    st2 = SimpleNamespace(trace={"done": done}, now=np.int32(INF_TIME),
+                          final_time=np.int32(INF_TIME))
+    rep2 = obs_report.drain(st2, tspec)
+    assert rep2["windows_used"] == W and rep2["horizon_ms"] == W * wm
 
 
 def test_trace_report_and_db_roundtrip(tmp_path):

@@ -193,9 +193,9 @@ def compare_drivers(name, B=64, chunk_steps=None, k=4, cmds=25):
             "events": mev,
             "events_per_sec": round(mev / max(mdt, 1e-9), 1),
             "hlo_lines": mhlo,
-        }, mev, mdt
+        }, mev, mdt, (minit, mega)
 
-    m, out["megachunk"], mev, mdt = timed_mega(spec)
+    m, out["megachunk"], mev, mdt, _ = timed_mega(spec)
     assert mev == ev, f"driver divergence: {mev} != {ev} events"
     out["sync_reduction"] = round((n + 1) / max(m, 1), 2)
 
@@ -210,10 +210,35 @@ def compare_drivers(name, B=64, chunk_steps=None, k=4, cmds=25):
     from fantoch_tpu.obs.trace import TraceSpec
 
     tspec = TraceSpec(window_ms=250, max_windows=128)
-    mt, out["megachunk_trace"], xev, xdt = timed_mega(
-        _dc.replace(spec, trace=tspec)
-    )
+    tr_spec = _dc.replace(spec, trace=tspec)
+    mt, out["megachunk_trace"], xev, xdt, (tinit, tmega) = timed_mega(tr_spec)
     out["megachunk_trace"]["extra_host_syncs"] = mt - m
+
+    # static purity cross-check (fantoch_tpu/analysis): the linter's
+    # verdict on the trace-enabled megachunk's jaxpr (no callbacks/host
+    # transfers anywhere, sub-jaxprs included) must AGREE with the runtime
+    # dispatch measurement above — a disagreement means one of the two
+    # purity oracles is broken, which is worse than either failing alone.
+    from fantoch_tpu.analysis import checker as lint_checker
+
+    # reuse the runner timed_mega built (same jit wrapper -> the trace of
+    # this ~100k-HLO-line program is a cache hit, not a second full trace)
+    traced = tmega.trace(envs, jax.eval_shape(tinit, envs))
+    verdict = lint_checker.purity_verdict(
+        traced, name=f"{name}.megachunk_trace"
+    )
+    runtime_pure = mt == m
+    out["static_purity"] = {
+        "pure": verdict["pure"],
+        "violations": len(verdict["violations"]),
+        "agrees_with_runtime": verdict["pure"] == runtime_pure,
+    }
+    if verdict["pure"] != runtime_pure:
+        raise SystemExit(
+            f"{name}: static purity verdict ({verdict['pure']}) disagrees"
+            f" with the runtime dispatch count (trace-on added"
+            f" {mt - m} syncs): {verdict['violations'][:2]}"
+        )
     if mt != m:
         raise SystemExit(
             f"{name}: trace-enabled megachunk used {mt} host syncs vs"
