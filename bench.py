@@ -37,7 +37,15 @@ timed budget slices, and only 1 of 6 protocols ever reported):
     per device call with the done-predicate evaluated on device, the state
     buffer is donated so XLA updates it in place, and the host syncs on one
     int8 per megachunk instead of materializing the full batched SimState
-    per chunk.
+    per chunk;
+  - the PERSISTENT AOT EXECUTABLE STORE (fantoch_tpu/cache) serializes the
+    compiled megachunk/init programs to disk keyed by their structural
+    jaxpr signature: the golden phase primes each protocol's entries in
+    its side budget, the timed slice and any RESPAWNED worker load instead
+    of compiling cold (the r04/r05 budget-exhaustion class), and the
+    per-protocol compile_s/run_s split plus cache hit/miss counters ride
+    the aggregate JSON so the warm-start win is visible in the bench
+    trajectory (BENCH_AOT=0 opts out).
 
 Reliability (the tunneled single-chip worker degrades for minutes after any
 fault and its remote-compile service is flaky on large programs):
@@ -139,10 +147,29 @@ def budget_left():
     return left
 
 
+from fantoch_tpu import cache as aot_cache
 from fantoch_tpu.core.config import Config
 from fantoch_tpu.core.planet import Planet
 from fantoch_tpu.core.workload import KeyGen, Workload
 from fantoch_tpu.engine import setup, sweep
+
+# Layer-1 AOT executable store (fantoch_tpu/cache): the timed megachunk +
+# init programs are compiled ONCE per (program structure, jax, backend,
+# device kind, machine) and serialized to disk — a respawned worker (the
+# r04/r05 failure class) or the next bench round RELOADS them instead of
+# recompiling cold inside its op budget. The golden phase pre-primes each
+# protocol's entries in its side budget. BENCH_AOT=0 opts out.
+BENCH_AOT = os.environ.get("BENCH_AOT", "1") != "0"
+_AOT_STORE = None
+
+
+def _aot_store():
+    global _AOT_STORE
+    if not BENCH_AOT:
+        return None
+    if _AOT_STORE is None:
+        _AOT_STORE = aot_cache.ExecutableStore()
+    return _AOT_STORE
 
 # Single-CPU-core baseline rates, MEASURED with tools/cpu_baseline.py on
 # this machine (one core of the host CPU): the native C++ oracles
@@ -429,6 +456,38 @@ def trace_stall_gap_ms(st, tspec):
     )
 
 
+def timed_shapes(name):
+    """`(n_configs, cmds, chunk_steps, pool)` for one timed protocol with
+    BENCH_SCALE / BENCH_CHUNK_STEPS applied, or None for an unknown name —
+    the ONE shape resolver shared by the worker's run op and the golden
+    phase's priming (executable identity is the structural jaxpr
+    signature: if the two paths ever disagreed on a single knob, priming
+    would silently populate keys the timed run never looks up)."""
+    row = [r for r in active_runs() if r[0] == name]
+    if not row:
+        return None
+    _, n_configs, cmds, chunk_steps, pool = row[0]
+    n_configs = max(
+        int(n_configs * float(os.environ.get("BENCH_SCALE", "1"))), 1
+    )
+    chunk_env = os.environ.get("BENCH_CHUNK_STEPS")
+    return n_configs, cmds, (int(chunk_env) if chunk_env else chunk_steps), \
+        pool
+
+
+def timed_batch(pdef, n_configs, commands_per_client, window, pool_slots,
+                leader, seed0=0):
+    """The timed-run batch for one protocol — the ONE build recipe shared
+    by `timed_run` and `prime_protocol`, for the same reason as
+    `timed_shapes`."""
+    tspec = trace_spec()
+    spec, wl, envs = build_batch(
+        pdef, n_configs, commands_per_client, window,
+        pool_slots=pool_slots, seed0=seed0, leader=leader, trace=tspec,
+    )
+    return tspec, spec, wl, envs
+
+
 def timed_run(pdef, n_configs, commands_per_client, window, chunk_steps,
               pool_slots, seed0=0, leader=None):
     """Megachunk-driven timed run: up to MEGA_K chunks per device call, one
@@ -437,17 +496,27 @@ def timed_run(pdef, n_configs, commands_per_client, window, chunk_steps,
     identical dispatch count, summary returned alongside the rate — and
     the run's OWN done channel feeds a stall watchdog: a wedged run aborts
     early with stall_abort marked in its trace digest."""
-    tspec = trace_spec()
-    spec, wl, envs = build_batch(
-        pdef, n_configs, commands_per_client, window,
-        pool_slots=pool_slots, seed0=seed0, leader=leader, trace=tspec,
+    tspec, spec, wl, envs = timed_batch(
+        pdef, n_configs, commands_per_client, window, pool_slots, leader,
+        seed0=seed0,
     )
+    store = _aot_store()
+    stats0 = store.stats() if store is not None else None
     init, mega = sweep.make_megachunk_runner(
-        spec, pdef, wl, chunk_steps, k=MEGA_K
+        spec, pdef, wl, chunk_steps, k=MEGA_K, cache=store
     )
-    warm, wd = mega(envs, init(envs))  # compile both programs off the clock
+    # first call resolves both programs (AOT store load on a warm cache,
+    # compile + persist on a cold one) and runs one megachunk — all off
+    # the clock; its wall IS the per-protocol compile/warm-start cost
+    tc0 = time.time()
+    warm, wd = mega(envs, init(envs))
     jax.block_until_ready(warm)
+    compile_s = time.time() - tc0
     del warm, wd
+    cinfo = {"compile_s": round(compile_s, 3)}
+    if store is not None:
+        s1 = store.stats()
+        cinfo.update({k: s1[k] - stats0[k] for k in s1})
     t0 = time.time()
     st = init(envs)
     dispatches = 0
@@ -483,7 +552,7 @@ def timed_run(pdef, n_configs, commands_per_client, window, chunk_steps,
         tsum = dict(tsum or {})
         tsum["stall_abort"] = True
         tsum["stall_gap_ms"] = stall_gap
-    return events, elapsed, ok, tsum
+    return events, elapsed, ok, tsum, cinfo
 
 
 def run_protocol(name, n_configs, commands_per_client, chunk_steps,
@@ -494,6 +563,11 @@ def run_protocol(name, n_configs, commands_per_client, chunk_steps,
     rates = []
     B, cs = n_configs, chunk_steps
     attempts = 0
+    # compile-vs-run split + AOT cache hit/miss counters, summed over the
+    # protocol's attempts — the warm-start win must be visible in the
+    # aggregate JSON, not inferred from wall-clock deltas between rounds
+    agg_cache = {"compile_s": 0.0, "hits": 0, "misses": 0, "corrupt": 0,
+                 "unserializable": 0}
     while len(rates) < repeats and attempts < repeats + 3:
         attempts += 1
         if rates and budget_left() < 120:
@@ -505,10 +579,12 @@ def run_protocol(name, n_configs, commands_per_client, chunk_steps,
         try:
             # pinned seed: repeats time the SAME workload, so spread
             # measures worker noise, not workload variance
-            events, elapsed, ok, tsum = timed_run(
+            events, elapsed, ok, tsum, cinfo = timed_run(
                 pdef, B, commands_per_client, window, cs, pool_slots,
                 leader=leader,
             )
+            for k in agg_cache:
+                agg_cache[k] = round(agg_cache[k] + cinfo.get(k, 0), 3)
         except Exception as e:  # noqa: BLE001
             if "UNAVAILABLE" not in str(e) and "remote_compile" not in str(e) \
                     and "DEADLINE" not in str(e):
@@ -529,12 +605,13 @@ def run_protocol(name, n_configs, commands_per_client, chunk_steps,
             + ("" if ok else "  [INCOMPLETE]"))
     if best is None:
         log(f"  {name}: skipped (no successful run)")
-        return 0, 0.0, False, None
+        return 0, 0.0, False, None, agg_cache
     rate, events, elapsed, ok, tsum = best
     spread = (max(rates) - min(rates)) / max(rates) if len(rates) > 1 else 0.0
     log(f"  {name}: best {rate:,.0f} events/sec over {len(rates)} runs "
-        f"(spread {spread:.0%})")
-    return events, elapsed, ok, tsum
+        f"(spread {spread:.0%}); compile {agg_cache['compile_s']}s,"
+        f" cache {agg_cache['hits']}h/{agg_cache['misses']}m")
+    return events, elapsed, ok, tsum, agg_cache
 
 
 # chunk lengths keep each device call well under the tunnel's ~40s stall
@@ -579,6 +656,50 @@ def active_runs():
 # warm worker (child side)
 # ---------------------------------------------------------------------------
 
+def prime_protocol(name):
+    """AOT-prime `name`'s timed-run programs into the executable store
+    during the golden side budget: trace + compile (or load) the EXACT
+    megachunk/init programs `timed_run` will dispatch — executable
+    identity is the structural jaxpr signature, so the shapes here must
+    match the timed path bit-for-bit (same build_batch, same MEGA_K).
+    Returns the store-counter delta, or None when priming is off/skipped.
+    Priming never fails the golden: any error is reported and swallowed."""
+    store = _aot_store()
+    # the guard must sit BELOW the parent's minimum prime slice (45 s), or
+    # floor-slice primes set an op deadline the guard immediately rejects
+    # and priming silently dead-bands exactly in tight-budget runs
+    if store is None or budget_left() < 15:
+        return None
+    shapes = timed_shapes(name)
+    if shapes is None:
+        return None
+    try:
+        n_configs, cmds, chunk_steps, pool = shapes
+        pdef, window, leader = build_protocol(name, cmds)
+        _tspec, spec, wl, envs = timed_batch(
+            pdef, n_configs, cmds, window, pool, leader
+        )
+        s0 = store.stats()
+        init, mega = sweep.make_megachunk_runner(
+            spec, pdef, wl, chunk_steps, k=MEGA_K
+        )
+        # resolve WITHOUT running a simulation step: get_or_compile only
+        # traces + compiles/loads (the sim runs in the timed phase)
+        store.get_or_compile(init, (envs,), program="sweep.init",
+                             protocol=name)
+        st_sds = jax.eval_shape(init, envs)
+        store.get_or_compile(mega, (envs, st_sds),
+                             program="sweep.megachunk", protocol=name,
+                             donation="state")
+        s1 = store.stats()
+        delta = {k: s1[k] - s0[k] for k in s1}
+        log(f"  prime[{name}]: {delta}")
+        return delta
+    except Exception as e:  # noqa: BLE001 — priming is best-effort
+        log(f"  prime[{name}]: FAILED {type(e).__name__}: {e}")
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def worker_main():
     """Persistent bench worker: initializes JAX ONCE, then serves ops from
     stdin (one JSON per line) until EOF, replying one JSON line per op on
@@ -595,8 +716,6 @@ def worker_main():
     backend = jax.default_backend()  # initialize the backend off any slice
     print(json.dumps({"op": "ready", "backend": backend}), flush=True)
     repeats = int(os.environ.get("BENCH_REPEATS", "1"))
-    scale = float(os.environ.get("BENCH_SCALE", "1"))
-    chunk_env = os.environ.get("BENCH_CHUNK_STEPS")
     for line in sys.stdin:
         line = line.strip()
         if not line:
@@ -619,20 +738,25 @@ def worker_main():
                 else:
                     device_golden(name, cmds=4 if SMOKE else 6)
                     resp["ok"] = True
+            elif op == "prime":
+                # AOT-prime the protocol's timed-run executables into the
+                # store — its OWN op, separate from the golden, so a slow
+                # or failed prime can never convert an already-passed
+                # golden into a recorded failure (the parent sends it
+                # AFTER the golden reply lands)
+                resp.update(ok=True, primed=prime_protocol(name))
             elif op == "run":
-                spec = [r for r in active_runs() if r[0] == name]
-                if not spec:
+                shapes = timed_shapes(name)
+                if shapes is None:
                     resp.update(ok=False, err="unknown protocol")
                 else:
-                    _, n_configs, cmds, chunk_steps, pool = spec[0]
-                    n_configs = max(int(n_configs * scale), 1)
-                    events, elapsed, ok, tsum = run_protocol(
-                        name, n_configs, cmds,
-                        int(chunk_env) if chunk_env else chunk_steps,
-                        pool, repeats,
+                    n_configs, cmds, chunk_steps, pool = shapes
+                    events, elapsed, ok, tsum, cinfo = run_protocol(
+                        name, n_configs, cmds, chunk_steps, pool, repeats,
                     )
                     resp.update(events=events, wall_s=round(elapsed, 3),
-                                ok=bool(ok), trace=tsum)
+                                ok=bool(ok), trace=tsum, cache=cinfo,
+                                compile_s=cinfo.get("compile_s", 0.0))
             else:
                 resp.update(ok=False, err=f"unknown op {op!r}")
         except Exception as e:  # noqa: BLE001 — soft faults stay contained
@@ -831,8 +955,8 @@ def main():
     # distinction rides into per_protocol and the aggregate (a FAILED
     # golden marks the protocol's record and forces the partial marker;
     # it never eats the timed slice)
-    recs = {n: {"name": n, "golden": None, "events": 0, "wall_s": 0.0,
-                "ok": False} for n in names}
+    recs = {n: {"name": n, "golden": None, "primed": None, "events": 0,
+                "wall_s": 0.0, "ok": False} for n in names}
     all_ok = True
 
     worker = _spawn_worker(SMOKE)
@@ -887,6 +1011,29 @@ def main():
         recs[name]["golden"] = bool(resp.get("ok"))
         if not resp.get("ok"):
             log(f"  golden[{name}]: FAILED ({resp.get('err', '?')})")
+            continue
+        # AOT-prime this protocol's timed executables with what is left of
+        # the side budget — AFTER the golden verdict is safely recorded,
+        # so a slow compile or a prime-killed worker costs budget, never
+        # a passed golden (the timed phase then loads instead of
+        # compiling; a skipped prime just means the timed slice compiles)
+        side_left = golden_budget - (time.time() - g_t0)
+        if side_left > 60 and budget_left() > 120:
+            prime_slice = max(min(side_left / 2, slice_s), 45.0)
+            presp = worker.call(
+                {"op": "prime", "name": name, "budget_s": prime_slice},
+                timeout=prime_slice + 60,
+            )
+            if presp is None:
+                log(f"  prime[{name}]: worker died or timed out —"
+                    " respawning (golden verdict kept)")
+                worker.close(kill=True)
+                worker = None
+            else:
+                # the prime result rides into the aggregate: consumers
+                # (and the smoke test) can tell "prime ran and the timed
+                # slice should hit" from "prime was budget-skipped"
+                recs[name]["primed"] = presp.get("primed")
     # every wanted golden must have been attempted AND passed: a skipped
     # golden (budget, dead worker) must not read as a verified device path
     goldens_ok = bool(golden_names) and all(
@@ -941,6 +1088,8 @@ def main():
                     wall_s=float(resp.get("wall_s", 0.0)),
                     ok=bool(resp.get("ok")),
                     trace=resp.get("trace"),
+                    cache=resp.get("cache"),
+                    compile_s=float(resp.get("compile_s", 0.0)),
                 )
         all_ok &= bool(rec.get("ok"))
         events, elapsed = rec["events"], rec["wall_s"]
@@ -949,6 +1098,18 @@ def main():
         per_protocol[name] = {
             "events": events,
             "wall_s": round(elapsed, 2),
+            # the compile/run split: wall_s (= run_s) is the TIMED loop
+            # only; compile_s is the off-the-clock first-call cost (AOT
+            # load on a warm store, full compile on a cold one) — the
+            # number the executable cache exists to shrink
+            "run_s": round(elapsed, 2),
+            "compile_s": round(float(rec.get("compile_s") or 0.0), 2),
+            # AOT store counters for this protocol's attempts: a warm
+            # bench must show hits > 0, a cold one misses > 0 (the cache
+            # trajectory criterion of tests/test_smoke_bench.py); primed
+            # records the golden phase's store delta (None = not primed)
+            "cache": rec.get("cache"),
+            "primed": rec.get("primed"),
             "events_per_sec": round(rate, 1),
             "cpu_core_events_per_sec": round(
                 base if base is not None else ESTIMATED_BASELINE, 1),

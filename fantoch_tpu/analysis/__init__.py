@@ -14,6 +14,7 @@ from .checker import (  # noqa: F401
     build_point,
     lint,
     lockstep_programs,
+    make_aot_fn,
     program_from_traced,
     purity_verdict,
     quantum_programs,
@@ -24,11 +25,16 @@ from .rules import (  # noqa: F401
     ALL_RULES,
     DonationRule,
     DtypeRule,
+    HloSizeRule,
     Leaf,
     PurityRule,
     StaticKeyRule,
     Violation,
+    check_executable_aliases,
     check_trace_stability,
     jaxpr_signature,
+    load_hlo_budgets,
+    load_hlo_manifest,
+    save_hlo_budgets,
     walk,
 )

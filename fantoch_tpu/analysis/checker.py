@@ -76,6 +76,10 @@ class Program:
     forbid_donation: bool = False  # non-donating (checkpointing) contract
     retrace_fn: Optional[Callable[[], str]] = None  # fresh-trace signature
     eqn_count: int = 0
+    # AOT thunk: `aot_fn(store) -> jax.stages.Compiled` (store=None compiles
+    # directly; a fantoch_tpu.cache.ExecutableStore loads-or-compiles) —
+    # the input of the executable-alias verification (@slow / --aot-alias)
+    aot_fn: Optional[Callable[[Any], Any]] = None
 
 
 def _keystr(kp) -> str:
@@ -106,6 +110,7 @@ def program_from_traced(
     forbid_donation: bool = False,
     key: Optional[Tuple] = None,
     retrace_fn=None,
+    aot_fn=None,
 ) -> Program:
     """Build a `Program` from a ``jax.jit(...).trace(...)`` result.
 
@@ -162,8 +167,25 @@ def program_from_traced(
         spec=spec, statics=tuple(statics), signature=sig,
         key=key if key is not None else (kind, protocol, repr(spec)),
         expect_donation=expect_donation, forbid_donation=forbid_donation,
-        retrace_fn=retrace_fn, eqn_count=eqns,
+        retrace_fn=retrace_fn, eqn_count=eqns, aot_fn=aot_fn,
     )
+
+
+def make_aot_fn(jitted, args: Tuple, *, program: str, protocol: str = "",
+                donation: str = "") -> Callable[[Any], Any]:
+    """Zero-or-one-arg thunk compiling `jitted` on `args` AOT: with a
+    `fantoch_tpu.cache.ExecutableStore` the compile is a one-time cost
+    (later lints deserialize); without one it lowers+compiles directly."""
+
+    def compile_fn(store=None):
+        if store is not None:
+            return store.get_or_compile(
+                jitted, args, program=program, protocol=protocol,
+                donation=donation,
+            )[0]
+        return jitted.trace(*args).lower().compile()
+
+    return compile_fn
 
 
 # ---------------------------------------------------------------------------
@@ -269,9 +291,10 @@ def lockstep_programs(protocol: str, *, trace: bool,
     statics = _statics_of(spec, tspec, wl)
     out = []
 
-    chunk_traced = jax.jit(
+    chunk_jit = jax.jit(
         lambda e, s: eng.run_chunk(e, s, _CHUNK_STEPS), donate_argnums=(1,)
-    ).trace(env, st_sds)
+    )
+    chunk_traced = chunk_jit.trace(env, st_sds)
 
     def retrace() -> str:
         # a FRESH engine build for the same key: catches traces that bake
@@ -291,18 +314,29 @@ def lockstep_programs(protocol: str, *, trace: bool,
         state_in_prefix="[1]", state_out_prefix="",
         expect_donation=True,
         retrace_fn=retrace if protocol == "basic" else None,
+        aot_fn=make_aot_fn(
+            chunk_jit, (env, st_sds),
+            program=_vname("lockstep.run_chunk", protocol, trace, faults),
+            protocol=protocol, donation="state",
+        ),
     ))
-    mega_traced = jax.jit(
+    mega_jit = jax.jit(
         lambda e, s: eng.run_megachunk(e, s, _CHUNK_STEPS, _MEGA_K),
         donate_argnums=(1,),
-    ).trace(env, st_sds)
+    )
     out.append(program_from_traced(
-        mega_traced,
+        mega_jit.trace(env, st_sds),
         name=_vname("lockstep.run_megachunk", protocol, trace, faults),
         kind="lockstep.run_megachunk", protocol=protocol, engine="lockstep",
         variant=_variant(trace, faults), spec=spec, statics=statics,
         state_in_prefix="[1]", state_out_prefix="[0]",
         expect_donation=True,
+        aot_fn=make_aot_fn(
+            mega_jit, (env, st_sds),
+            program=_vname("lockstep.run_megachunk", protocol, trace,
+                           faults),
+            protocol=protocol, donation="state",
+        ),
     ))
     return out
 
@@ -331,6 +365,11 @@ def sweep_programs(protocol: str, *, trace: bool) -> List[Program]:
         variant=_variant(trace, None), spec=spec, statics=statics,
         state_in_prefix="[1]", state_out_prefix="[0]",
         expect_donation=True,
+        aot_fn=make_aot_fn(
+            mega, (envs, st_sds),
+            program=_vname("sweep.megachunk", protocol, trace, None),
+            protocol=protocol, donation="state",
+        ),
     ))
     if protocol == "basic":
         initc, chunk, _done = sweep.make_chunked_runner(
@@ -344,6 +383,12 @@ def sweep_programs(protocol: str, *, trace: bool) -> List[Program]:
             variant=_variant(trace, None), spec=spec, statics=statics,
             state_in_prefix="[1]", state_out_prefix="",
             forbid_donation=True,
+            aot_fn=make_aot_fn(
+                chunk, (envs, st_sds_c),
+                program=_vname("sweep.chunked(donate=False)", protocol,
+                               trace, None),
+                protocol=protocol, donation="",
+            ),
         ))
     return out
 
@@ -438,14 +483,21 @@ def build_matrix(
 
 
 def run_check(programs: Sequence[Program], rules=ALL_RULES,
-              retrace: bool = True) -> Dict[str, Any]:
+              retrace: bool = True, aot_alias: bool = False,
+              aot_store=None) -> Dict[str, Any]:
     """Apply the rule set to every program; returns the JSON-able report.
 
     Beyond the per-program rules, two cross-program recompile-hygiene
     checks run here: (a) programs sharing a compile key must share a jaxpr
     signature (same key, different trace = an avoidable recompile), and
     (b) programs carrying a `retrace_fn` are re-traced from scratch and
-    must reproduce their signature bit-for-bit."""
+    must reproduce their signature bit-for-bit.
+
+    `aot_alias=True` additionally AOT-compiles every program that carries
+    an `aot_fn` (through `aot_store` — a fantoch_tpu.cache.ExecutableStore
+    — when given, so re-lints deserialize instead of recompiling) and
+    verifies the executable's actual input_output_aliases against the
+    static donation verdict (@slow tier / `lint --aot-alias`)."""
     violations: List[Violation] = []
     by_key: Dict[Tuple, Tuple[str, str]] = {}
     for p in programs:
@@ -454,6 +506,10 @@ def run_check(programs: Sequence[Program], rules=ALL_RULES,
         if retrace and p.retrace_fn is not None:
             violations.extend(
                 rules_mod.check_trace_stability(p, p.retrace_fn())
+            )
+        if aot_alias:
+            violations.extend(
+                rules_mod.check_executable_aliases(p, aot_store)
             )
         seen = by_key.get(p.key)
         if seen is not None and seen[1] != p.signature:
@@ -501,12 +557,15 @@ def lint(
     fault_variants: Sequence[bool] = (False, True),
     retrace: bool = True,
     verbose: bool = False,
+    aot_alias: bool = False,
+    aot_store=None,
 ) -> Dict[str, Any]:
     """Trace the matrix, run every rule, return the report dict."""
     programs, skips = build_matrix(
         protocols, engines, trace_variants, fault_variants, verbose=verbose
     )
-    report = run_check(programs, retrace=retrace)
+    report = run_check(programs, retrace=retrace, aot_alias=aot_alias,
+                       aot_store=aot_store)
     report["skipped"] = skips
     report["matrix"] = {
         "protocols": list(protocols),

@@ -26,14 +26,32 @@ The rule set is the static form of the engine contract
 - ``static-keys``— every object used as a static recompile key is hashable
                    and ``__eq__``/``hash``/``repr``-stable, and retracing a
                    program under the same key yields the same jaxpr
-                   signature (an unstable trace is an avoidable recompile).
+                   signature (an unstable trace is an avoidable recompile);
+- ``hlo-size``   — per-program equation-count budgets (the ROADMAP'd
+                   cross-protocol HLO size regression rule): every engine
+                   program's eqn count is checked against the committed
+                   manifest (analysis/hlo_budgets.json), failing on >10%
+                   growth — a silently ballooning program is a compile-time
+                   and executable-cache regression before it is a runtime
+                   one. `lint --update-budgets` is the escape hatch.
+
+Beyond the trace-time rules, `check_executable_aliases` verifies a
+COMPILED executable's actual `input_output_alias` pairs against the
+donation rule's static alias-eligibility verdict — affordable now that
+the AOT cache (fantoch_tpu/cache) makes lowering+compiling a lint program
+a one-time cost; it runs in the @slow full-matrix lint and behind
+`lint --aot-alias`.
 """
 from __future__ import annotations
 
 import copy
 import dataclasses
 import hashlib
-from typing import Any, Iterator, List, Optional, Sequence, Tuple
+import json
+import math
+import os
+import re
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -437,6 +455,179 @@ class StaticKeyRule:
         return out
 
 
+# ---------------------------------------------------------------------------
+# HLO size budgets
+# ---------------------------------------------------------------------------
+
+# allowed growth over the committed budget before the rule fires; the
+# manifest records the eqn count at the time budgets were last updated, so
+# organic drift (a new trace channel, a protocol fix) stays under the slack
+# while an accidental 2x program (a loop unrolled, a vmap lost) fails lint
+HLO_BUDGET_SLACK = 0.10
+
+_BUDGET_PATH = os.path.join(os.path.dirname(__file__), "hlo_budgets.json")
+
+
+def load_hlo_manifest(
+    path: Optional[str] = None,
+) -> Tuple[Dict[str, int], float]:
+    """The committed manifest: (name -> eqn budget, slack). The persisted
+    slack is honored — an edited manifest value changes what lint
+    enforces, it is not decorative."""
+    try:
+        with open(path or _BUDGET_PATH) as f:
+            data = json.load(f)
+        budgets = {str(k): int(v) for k, v in data.get("budgets", {}).items()}
+        return budgets, float(data.get("slack", HLO_BUDGET_SLACK))
+    except (OSError, ValueError, TypeError, AttributeError):
+        return {}, HLO_BUDGET_SLACK
+
+
+def load_hlo_budgets(path: Optional[str] = None) -> Dict[str, int]:
+    """The committed per-program eqn-count manifest (name -> budget)."""
+    return load_hlo_manifest(path)[0]
+
+
+def save_hlo_budgets(budgets: Dict[str, int],
+                     path: Optional[str] = None) -> str:
+    """Write the manifest (`lint --update-budgets`); merges nothing — the
+    caller passes the full mapping it wants committed."""
+    path = path or _BUDGET_PATH
+    with open(path, "w") as f:
+        json.dump(
+            {"slack": HLO_BUDGET_SLACK,
+             "budgets": {k: budgets[k] for k in sorted(budgets)}},
+            f, indent=1,
+        )
+        f.write("\n")
+    return path
+
+
+class HloSizeRule:
+    """Every ENGINE program's equation count stays within slack of its
+    committed budget. Synthetic/toy programs (engine "?") are exempt —
+    budgets exist for the shipped driver programs, whose names (protocol +
+    variant included) are stable across runs."""
+
+    id = "hlo-size"
+
+    def __init__(self, budgets: Optional[Dict[str, int]] = None,
+                 slack: Optional[float] = None):
+        self._budgets = budgets
+        self._slack = slack
+
+    @property
+    def budgets(self) -> Dict[str, int]:
+        if self._budgets is None:
+            self._budgets, file_slack = load_hlo_manifest()
+            if self._slack is None:
+                self._slack = file_slack
+        return self._budgets
+
+    @property
+    def slack(self) -> float:
+        if self._slack is None:
+            self.budgets  # loads the manifest (and its slack) lazily
+        return self._slack if self._slack is not None else HLO_BUDGET_SLACK
+
+    def check(self, program) -> List[Violation]:
+        if program.engine == "?":
+            return []
+        budget = self.budgets.get(program.name)
+        if budget is None:
+            return [Violation(
+                rule="hlo-size/unbudgeted", program=program.name,
+                path="hlo_budgets.json", primitive="",
+                detail=f"no eqn-count budget recorded for this program"
+                       f" (currently {program.eqn_count} eqns) — run"
+                       " `python -m fantoch_tpu lint --update-budgets`",
+            )]
+        limit = int(math.ceil(budget * (1.0 + self.slack)))
+        if program.eqn_count > limit:
+            pct = 100.0 * (program.eqn_count - budget) / max(budget, 1)
+            return [Violation(
+                rule="hlo-size/regression", program=program.name,
+                path="eqn_count", primitive="",
+                detail=f"{program.eqn_count} eqns is +{pct:.0f}% over the"
+                       f" {budget}-eqn budget (> {self.slack:.0%} slack) —"
+                       " a compile-time/cache-size regression; if"
+                       " intentional, re-baseline with `lint"
+                       " --update-budgets`",
+            )]
+        return []
+
+
+# ---------------------------------------------------------------------------
+# compiled-executable alias verification (AOT; @slow / --aot-alias)
+# ---------------------------------------------------------------------------
+
+
+def _count_executable_aliases(hlo_text: str) -> int:
+    """Number of `input_output_alias` pairs in a compiled module's HLO.
+
+    The header renders as ``input_output_alias={ {0}: (1, {}, may-alias),
+    ... }``; the block closes with " }" (entry separators are "), {" and
+    parameter indices are single-level), and every entry ends with
+    ``may-alias)`` or ``must-alias)``."""
+    m = re.search(r"input_output_alias=\{(.*?) \}", hlo_text)
+    if m is None:
+        return 0
+    return len(re.findall(r"-alias\)", m.group(1)))
+
+
+def check_executable_aliases(program, store=None) -> List[Violation]:
+    """Verify the COMPILED executable's input_output_aliases against the
+    static donation verdict (the ROADMAP follow-up deferred "once AOT
+    lowering is cheap enough" — the executable cache makes it so).
+
+    The static `DonationRule` argues from avals that XLA *can* alias every
+    donated leaf; this check confirms XLA actually *did*: the executable
+    must carry exactly one alias pair per alias-eligible donated leaf, and
+    a `forbid_donation` program must carry none. Programs without an AOT
+    thunk (`aot_fn`) are skipped."""
+    if getattr(program, "aot_fn", None) is None:
+        return []
+    try:
+        compiled = program.aot_fn(store)
+        hlo = compiled.as_text()
+    except Exception:  # noqa: BLE001 — retry without the store first
+        # a store problem (corrupt entry, a loaded executable that cannot
+        # render HLO) must not masquerade as a donation violation: fall
+        # back to a direct store-free compile before flagging anything —
+        # the same cache-may-cost-time-never-correctness contract CachedFn
+        # keeps at runtime
+        try:
+            compiled = program.aot_fn(None)
+            hlo = compiled.as_text()
+        except Exception as e:  # noqa: BLE001 — uncompilable IS news
+            return [Violation(
+                rule="donation/executable-alias", program=program.name,
+                path="aot", primitive="",
+                detail=f"AOT compile/inspect failed: {type(e).__name__}:"
+                       f" {e}"[:300],
+            )]
+    aliased = _count_executable_aliases(hlo)
+    donated = sum(1 for lf in program.args if lf.donated)
+    ineligible = sum(
+        1 for v in DonationRule().check(program)
+        if v.rule == "donation/alias"
+    )
+    expected = donated - ineligible
+    if program.forbid_donation:
+        expected = 0
+    if aliased != expected:
+        return [Violation(
+            rule="donation/executable-alias", program=program.name,
+            path="input_output_alias", primitive="",
+            detail=f"compiled executable aliases {aliased} buffer(s) but"
+                   f" the static donation verdict expects {expected}"
+                   f" ({donated} donated leaf(s), {ineligible} statically"
+                   " ineligible) — the compiled donation contract diverged"
+                   " from the traced one",
+        )]
+    return []
+
+
 def check_trace_stability(program, retraced_signature: str) -> List[Violation]:
     """Same compile key, different jaxpr -> an avoidable recompile (e.g.
     a trace that bakes in a Python object id, an env var read mid-trace, a
@@ -453,4 +644,5 @@ def check_trace_stability(program, retraced_signature: str) -> List[Violation]:
     )]
 
 
-ALL_RULES = (PurityRule(), DtypeRule(), DonationRule(), StaticKeyRule())
+ALL_RULES = (PurityRule(), DtypeRule(), DonationRule(), StaticKeyRule(),
+             HloSizeRule())

@@ -1,0 +1,17 @@
+"""Persistent AOT executable cache (`python -m fantoch_tpu cache ...`).
+
+`cache.store.ExecutableStore` serializes compiled driver executables to
+disk keyed by the structural jaxpr signature the static checker
+(fantoch_tpu/analysis) already verifies retrace-stable, so sweeps, the
+bench worker and CI reload instead of recompiling — the one fixed cost
+the megachunk/donation work of earlier rounds could not amortize.
+`ensure_native_cache` wires JAX's own persistent compilation cache as
+the layer-2 backstop for programs outside the store.
+"""
+from .store import (  # noqa: F401
+    CachedFn,
+    ExecutableStore,
+    default_root,
+    ensure_native_cache,
+    machine_fingerprint,
+)

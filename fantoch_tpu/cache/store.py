@@ -1,0 +1,457 @@
+"""Persistent AOT executable cache for the engine driver programs.
+
+Every measured bottleneck left in the bench trajectory is compile time,
+not simulation time (BASELINE.md: `fpaxos-baseline` flat at ~1,143
+configs/hour "compile-dominated"; BENCH_r05 burned its whole budget on
+recompiles after worker respawns). The fix is the same one the static
+checker (fantoch_tpu/analysis) already prepared for: the structural jaxpr
+signature — a hash over primitives + avals + stable params, pinned
+retrace-stable by the `static-keys` lint rule — is exactly the right
+compile-identity key, so driver executables can be compiled ONCE, written
+to disk, and reloaded by any later process (a respawned bench worker, the
+next sweep, a CI re-run) instead of recompiled cold.
+
+Two layers:
+
+- **Layer 1 (this module)** — `ExecutableStore`: AOT lower+compile via
+  ``jax.jit(...).trace(...).lower().compile()`` and serialize/deserialize
+  whole executables (``jax.experimental.serialize_executable``) to an
+  on-disk store keyed by (structural jaxpr signature, jax version,
+  backend platform, device kind, machine fingerprint, donation contract).
+  A key miss, a truncated payload, or any deserialization failure falls
+  back to a normal compile and overwrites the entry — the cache can cost
+  time but can NEVER substitute a wrong executable (the key embeds the
+  full program structure, and every failure path recompiles).
+- **Layer 2** — `ensure_native_cache`: JAX's own persistent compilation
+  cache (``jax_compilation_cache_dir`` + a min-compile-time threshold) as
+  the backstop for the programs outside the store (goldens, init
+  programs, test-suite jits).
+
+The hot consumers (`engine/sweep.py` runner factories, `exp/harness.py`,
+`bench.py`) take a store handle and wrap their jitted drivers with
+`ExecutableStore.wrap`; `python -m fantoch_tpu cache {warm,ls,purge}`
+manages the store from the CLI.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import platform as _platform
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+# bump when the entry format or key recipe changes: old entries become
+# misses (recompiles), never misreads
+FORMAT_VERSION = 2
+
+
+def machine_fingerprint() -> str:
+    """Host identity folded into every key: XLA:CPU executables embed host
+    CPU features, and loading an entry written on a different machine can
+    SIGILL (the same reason bench.py namespaces its native cache dir)."""
+    return hashlib.sha1(
+        (_platform.machine() + _platform.processor() + _platform.node())
+        .encode()
+    ).hexdigest()[:8]
+
+
+def default_root() -> str:
+    """`FANTOCH_AOT_CACHE` or `<repo>/.jax_cache/aot` (next to the native
+    persistent cache bench.py already keeps there)."""
+    env = os.environ.get("FANTOCH_AOT_CACHE")
+    if env:
+        return env
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+    return os.path.join(repo, ".jax_cache", "aot")
+
+
+def ensure_native_cache(cache_dir: Optional[str] = None,
+                        min_compile_secs: float = 1.0) -> str:
+    """Layer 2: enable JAX's persistent compilation cache if the process
+    has not configured one yet; returns the effective directory. A dir the
+    caller (bench.py, tests/conftest.py) already set wins — this is the
+    backstop for entry points that never thought about caching."""
+    import jax
+
+    current = jax.config.jax_compilation_cache_dir
+    if current:
+        return current
+    cache_dir = cache_dir or os.path.join(
+        os.path.dirname(default_root()), machine_fingerprint()
+    )
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", min_compile_secs
+    )
+    return cache_dir
+
+
+def _donated_indices(traced) -> str:
+    """Flat-leaf indices the jit donates, e.g. "1,2,5" — the trace-derived
+    donation contract folded into every key."""
+    import jax
+
+    return ",".join(
+        str(i) for i, ai in enumerate(
+            jax.tree_util.tree_leaves(traced.args_info)
+        )
+        if getattr(ai, "donated", False)
+    )
+
+
+class ExecutableStore:
+    """Directory-backed store of serialized XLA executables.
+
+    `jax_version`/`backend` default to the live process and exist as
+    parameters so tests can pin a mismatched key (a store constructed with
+    a different version string must MISS against real entries, never load
+    them)."""
+
+    def __init__(self, root: Optional[str] = None, *,
+                 jax_version: Optional[str] = None,
+                 backend: Optional[str] = None):
+        import jax
+
+        self.root = root or default_root()
+        self.jax_version = jax_version or jax.__version__
+        self.platform = backend or jax.default_backend()
+        try:
+            self.device_kind = jax.devices(self.platform)[0].device_kind
+        except RuntimeError:
+            self.device_kind = "?"
+        self.machine = machine_fingerprint()
+        # counters: hits (deserialized), misses (compiled), corrupt
+        # (entry present but unloadable -> recompiled), unserializable
+        # (compiled fine but the backend refused serialization)
+        self.hits = 0
+        self.misses = 0
+        self.corrupt = 0
+        self.unserializable = 0
+        # keys whose executables this backend cannot serialize (learned
+        # in-process or from a persisted meta marker): later misses on
+        # them compile through the NORMAL path — native persistent cache
+        # enabled — instead of paying the force-fresh compile the store's
+        # serialization workaround requires
+        self._unser_keys: set = set()
+
+    # -- keys ---------------------------------------------------------------
+
+    def key_for(self, signature: str, donation: str = "") -> str:
+        h = hashlib.sha1()
+        for part in (f"v{FORMAT_VERSION}", signature, self.jax_version,
+                     self.platform, self.device_kind, self.machine,
+                     donation):
+            h.update(str(part).encode())
+            h.update(b"\x00")
+        return h.hexdigest()[:24]
+
+    def _paths(self, key: str) -> Tuple[str, str]:
+        return (os.path.join(self.root, f"{key}.exe"),
+                os.path.join(self.root, f"{key}.json"))
+
+    # -- core ---------------------------------------------------------------
+
+    def get_or_compile(self, jitted, args: Tuple, *, program: str = "?",
+                       protocol: str = "", donation: str = ""):
+        """AOT-resolve one jitted program against the store.
+
+        Traces `jitted` on `args` (cheap — the compile is what the store
+        amortizes), derives the structural signature, and either
+        deserializes the stored executable or compiles + persists it.
+        Returns ``(compiled, info)`` where `compiled` is a
+        ``jax.stages.Compiled`` honoring the jit's donation contract and
+        `info` records hit/miss, key and the trace/load/compile splits."""
+        from ..analysis.rules import jaxpr_signature
+
+        t0 = time.perf_counter()
+        traced = jitted.trace(*args)
+        sig = jaxpr_signature(traced.jaxpr, traced.jaxpr.in_avals)
+        # the donation component of the key is DERIVED from the trace
+        # (donate_argnums does not change the jaxpr, so a donating and a
+        # non-donating build share a structural signature and differ only
+        # in input_output_aliases) — deriving it here means no caller can
+        # mislabel a build and load an executable with the opposite
+        # aliasing; the `donation` parameter is display metadata only
+        key = self.key_for(sig, _donated_indices(traced))
+        info: Dict[str, Any] = {
+            "key": key, "signature": sig, "program": program,
+            "protocol": protocol, "hit": False,
+            "trace_s": round(time.perf_counter() - t0, 3),
+        }
+        exe_path, meta_path = self._paths(key)
+        payload = None
+        try:
+            with open(exe_path, "rb") as f:
+                payload = f.read()
+        except OSError:
+            pass
+        if payload is not None:
+            try:
+                compiled = self._load(traced, payload)
+                self.hits += 1
+                info.update(
+                    hit=True,
+                    load_s=round(time.perf_counter() - t0 - info["trace_s"],
+                                 3),
+                )
+                return compiled, info
+            except Exception as e:  # noqa: BLE001 — any load failure
+                # truncated/corrupted/incompatible entry: recompile and
+                # overwrite — never a wrong-executable reuse (the
+                # round-trip test corrupts an entry and pins this path)
+                self.corrupt += 1
+                info["fallback"] = f"{type(e).__name__}: {e}"[:200]
+        t1 = time.perf_counter()
+        unser = key in self._unser_keys or self._marked_unserializable(key)
+        if unser:
+            # serialization is known broken for this key: the store can
+            # never amortize it, so do NOT pay the native-cache-bypassing
+            # fresh compile — the plain jit-equivalent path (native
+            # persistent cache enabled) is the best available here
+            compiled = traced.lower().compile()
+            info["compile_s"] = round(time.perf_counter() - t1, 3)
+            info["unserializable"] = "marked"
+            self.misses += 1
+            return compiled, info
+        compiled = self._compile(traced)
+        info["compile_s"] = round(time.perf_counter() - t1, 3)
+        self.misses += 1
+        self._write(key, traced, compiled, {
+            "key": key,
+            "format": FORMAT_VERSION,
+            "signature": sig,
+            "program": program,
+            "protocol": protocol,
+            "donation": donation,
+            "jax": self.jax_version,
+            "platform": self.platform,
+            "device_kind": self.device_kind,
+            "machine": self.machine,
+            "created": time.time(),
+            "compile_s": info["compile_s"],
+        }, info)
+        return compiled, info
+
+    @staticmethod
+    def _compile(traced):
+        """AOT-compile with JAX's NATIVE persistent cache disabled for the
+        call: an executable that was itself deserialized from the native
+        cache re-serializes to an incomplete payload (missing object-code
+        symbols — loads fail with "Symbols not found"), so layer 1 must
+        always serialize a freshly-built executable. The store entry then
+        covers what the skipped native-cache entry would have.
+
+        The config flip alone is not enough: `is_cache_used` memoizes its
+        verdict on the first compile of the process, so the enabled-state
+        must be RESET around the call (jax._src.compilation_cache
+        .reset_cache — the hook jax's own tests use). Should the internal
+        hook ever disappear, the write-time round-trip verification in
+        `_write` still catches lossy payloads; entries then degrade to
+        unserializable instead of poisoning readers."""
+        import jax
+
+        prev = jax.config.jax_compilation_cache_dir
+        if prev is None:
+            return traced.lower().compile()
+        try:
+            from jax._src.compilation_cache import reset_cache
+        except ImportError:  # pragma: no cover — verify-only fallback
+            return traced.lower().compile()
+        try:
+            jax.config.update("jax_compilation_cache_dir", None)
+            reset_cache()
+            return traced.lower().compile()
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)
+            reset_cache()
+
+    def _marked_unserializable(self, key: str) -> bool:
+        """A persisted meta without an .exe and with the unserializable
+        marker: an earlier process proved this key cannot round-trip."""
+        try:
+            with open(self._paths(key)[1]) as f:
+                marked = bool(json.load(f).get("unserializable"))
+        except (OSError, ValueError):
+            return False
+        if marked:
+            self._unser_keys.add(key)
+        return marked
+
+    def _load(self, traced, payload: bytes):
+        """Deserialize `payload` into a Compiled, re-deriving the arg/out
+        pytrees from the fresh trace (treedefs are not serializable; the
+        trace that computed the key already carries them)."""
+        import jax
+        from jax.experimental import serialize_executable as se
+
+        in_tree = jax.tree_util.tree_flatten(traced.args_info)[1]
+        out_tree = jax.tree_util.tree_structure(traced.out_info)
+        return se.deserialize_and_load(payload, in_tree, out_tree,
+                                       self.platform)
+
+    def _write(self, key: str, traced, compiled, meta: Dict[str, Any],
+               info: Dict[str, Any]) -> None:
+        from jax.experimental import serialize_executable as se
+
+        try:
+            payload, _in_tree, _out_tree = se.serialize(compiled)
+            # verify BEFORE publishing: the payload must round-trip in
+            # this very process, or the entry would poison every later
+            # reader (each would fall back, but the store would read as
+            # permanently corrupt) — a backend whose serialization is
+            # lossy counts as unserializable, not as an entry
+            self._load(traced, payload)
+        except Exception as e:  # noqa: BLE001 — backend refused; not fatal
+            self.unserializable += 1
+            self._unser_keys.add(key)
+            info["unserializable"] = f"{type(e).__name__}: {e}"[:200]
+            # persist the verdict (meta only, no .exe): later processes
+            # then skip straight to the normal compile path instead of
+            # re-discovering it with a force-fresh compile per attempt
+            meta["unserializable"] = info["unserializable"]
+            try:
+                os.makedirs(self.root, exist_ok=True)
+                fd, tmp = tempfile.mkstemp(dir=self.root)
+                with os.fdopen(fd, "w") as f:
+                    f.write(json.dumps(meta))
+                os.replace(tmp, self._paths(key)[1])
+            except OSError:
+                pass
+            return
+        meta["size"] = len(payload)
+        exe_path, meta_path = self._paths(key)
+        os.makedirs(self.root, exist_ok=True)
+        try:
+            # atomic publish (tmp + rename), META FIRST: a failure after
+            # the meta lands leaves a visible `present: false` entry
+            # (harmless — readers miss on the absent .exe), whereas an
+            # .exe without meta would serve hits invisible to
+            # `entries()`/`purge` — a purge meant to produce a cold
+            # number would then silently measure warm
+            for path, data, mode in ((meta_path, json.dumps(meta), "w"),
+                                     (exe_path, payload, "wb")):
+                fd, tmp = tempfile.mkstemp(dir=self.root)
+                with os.fdopen(fd, mode) as f:
+                    f.write(data)
+                os.replace(tmp, path)
+        except OSError as e:
+            info["write_error"] = f"{type(e).__name__}: {e}"[:200]
+
+    # -- wrapper ------------------------------------------------------------
+
+    def wrap(self, jitted, *, program: str = "?", protocol: str = "",
+             donation: str = "") -> "CachedFn":
+        return CachedFn(self, jitted, program=program, protocol=protocol,
+                        donation=donation)
+
+    # -- management ---------------------------------------------------------
+
+    def stats(self) -> Dict[str, int]:
+        return {"hits": self.hits, "misses": self.misses,
+                "corrupt": self.corrupt,
+                "unserializable": self.unserializable}
+
+    def entries(self) -> List[Dict[str, Any]]:
+        out = []
+        try:
+            names = sorted(os.listdir(self.root))
+        except OSError:
+            return out
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self.root, name)) as f:
+                    meta = json.load(f)
+            except (OSError, ValueError):
+                continue
+            exe = os.path.join(self.root, name[:-5] + ".exe")
+            meta["present"] = os.path.exists(exe)
+            out.append(meta)
+        return out
+
+    def purge(self, *, program: Optional[str] = None,
+              protocol: Optional[str] = None) -> int:
+        """Delete entries (all by default; filter by program/protocol
+        substring). Returns the number of executables removed."""
+        removed = 0
+        for meta in self.entries():
+            if program and program not in meta.get("program", ""):
+                continue
+            if protocol and protocol != meta.get("protocol", ""):
+                continue
+            exe_path, meta_path = self._paths(meta["key"])
+            for p in (exe_path, meta_path):
+                try:
+                    os.remove(p)
+                except OSError:
+                    continue
+            removed += 1
+        return removed
+
+
+class CachedFn:
+    """Callable façade over (store, jitted): the first call per argument
+    structure resolves through the store (load or compile+persist); later
+    calls dispatch straight to the in-process executable. Every failure
+    path falls back to the plain jitted callable — the cache may cost
+    time, it never changes results or availability."""
+
+    def __init__(self, store: ExecutableStore, jitted, *, program: str,
+                 protocol: str = "", donation: str = ""):
+        self.store = store
+        self.jitted = jitted
+        self.program = program
+        self.protocol = protocol
+        self.donation = donation
+        self.info: Optional[Dict[str, Any]] = None  # last resolution
+        self._compiled: Dict[Tuple, Any] = {}
+
+    @staticmethod
+    def _struct_key(args: Tuple) -> Tuple:
+        import jax
+        import numpy as np
+
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        return (treedef, tuple(
+            (np.shape(x), str(getattr(x, "dtype", None)
+                              or np.asarray(x).dtype))
+            for x in leaves
+        ))
+
+    def __call__(self, *args):
+        k = self._struct_key(args)
+        fn = self._compiled.get(k)
+        if fn is None:
+            try:
+                fn, self.info = self.store.get_or_compile(
+                    self.jitted, args, program=self.program,
+                    protocol=self.protocol, donation=self.donation,
+                )
+            except Exception as e:  # noqa: BLE001 — cache machinery only
+                self.info = {"hit": False,
+                             "error": f"{type(e).__name__}: {e}"[:200]}
+                fn = self.jitted
+            self._compiled[k] = fn
+        try:
+            return fn(*args)
+        except Exception:
+            if fn is self.jitted:
+                raise
+            # a loaded executable that rejects the call (arg placement,
+            # layout drift) is a cache problem, not a caller problem:
+            # pin the fallback and re-dispatch through the normal jit.
+            # UNLESS the failed call already consumed donated inputs — a
+            # retry on deleted buffers would raise "Array has been
+            # deleted" and mask the real cache failure; re-raise it.
+            self._compiled[k] = self.jitted
+            import jax
+
+            for leaf in jax.tree_util.tree_leaves(args):
+                if getattr(leaf, "is_deleted", lambda: False)():
+                    raise
+            return self.jitted(*args)

@@ -60,6 +60,7 @@ def make_chunked_runner(
     wl: Workload,
     chunk_steps: int = 50_000,
     donate: bool = True,
+    cache=None,
 ):
     """Build `(init, chunk, done)` for segment-wise batched execution.
 
@@ -73,6 +74,12 @@ def make_chunked_runner(
     deletes the *input* state after each call: callers that keep a reference
     to a pre-chunk state across the call — e.g. to `save_state` the same
     snapshot after advancing past it — must pass `donate=False`.
+
+    `cache` (a `fantoch_tpu.cache.ExecutableStore`) resolves the chunk and
+    init programs through the persistent AOT executable store: a warm store
+    loads the serialized executable instead of recompiling (a key miss or a
+    corrupted entry falls back to normal jit — results are identical either
+    way, pinned by tests/test_cache.py).
     """
     from .lockstep import make_engine
 
@@ -82,6 +89,12 @@ def make_chunked_runner(
         jax.vmap(lambda env, st: eng.run_chunk(env, st, chunk_steps)),
         donate_argnums=(1,) if donate else (),
     )
+    if cache is not None:
+        init = cache.wrap(init, program="sweep.init", protocol=pdef.name)
+        chunk = cache.wrap(
+            chunk, program="sweep.chunked", protocol=pdef.name,
+            donation="state" if donate else "",
+        )
 
     done_fn = jax.jit(jax.vmap(eng.done_flag))
 
@@ -101,6 +114,7 @@ def make_megachunk_runner(
     # watchdog, and a megachunk multiplies single-call runtime by up to k
     k: int = 4,
     donate: bool = True,
+    cache=None,
 ):
     """Build `(init, mega)` for device-resident megachunk execution.
 
@@ -117,6 +131,11 @@ def make_megachunk_runner(
     `donate=True` the state argument is donated so XLA updates it in place;
     checkpointing callers that re-read a pre-call state must use the
     non-donating chunked runner instead.
+
+    `cache` (a `fantoch_tpu.cache.ExecutableStore`) resolves both programs
+    through the persistent AOT executable store — the bench's timed driver
+    is the store's primary tenant (a respawned worker reloads the
+    serialized executable instead of recompiling cold).
     """
     from .lockstep import make_engine
 
@@ -130,6 +149,12 @@ def make_megachunk_runner(
         return st, done.min()
 
     mega = jax.jit(_mega, donate_argnums=(1,) if donate else ())
+    if cache is not None:
+        init = cache.wrap(init, program="sweep.init", protocol=pdef.name)
+        mega = cache.wrap(
+            mega, program="sweep.megachunk", protocol=pdef.name,
+            donation="state" if donate else "",
+        )
     return init, mega
 
 

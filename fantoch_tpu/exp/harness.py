@@ -290,6 +290,53 @@ def _engine_fingerprint(pt0, C: int, trace=None) -> Dict[str, Any]:
     }
 
 
+def _point_config(pt: Point, n: int, gc_interval_ms: int,
+                  leader: Optional[int]) -> Config:
+    """The engine Config of one grid point — the ONE pt->Config mapping
+    (the bucket's spec uses pt0's, every env its own pt's; a field added
+    here reaches both)."""
+    return Config(
+        n=n, f=pt.f, gc_interval_ms=gc_interval_ms, leader=leader,
+        leader_check_interval_ms=pt.leader_check_interval_ms or None,
+        nfr=pt.nfr,
+        tempo_tiny_quorums=pt.tempo_tiny_quorums,
+        tempo_clock_bump_interval_ms=(
+            pt.tempo_clock_bump_interval_ms or None
+        ),
+        tempo_detached_send_interval_ms=(
+            pt.tempo_detached_send_interval_ms or None
+        ),
+        executor_monitor_pending_interval_ms=(
+            pt.executor_monitor_pending_interval_ms or None
+        ),
+        skip_fast_ack=pt.skip_fast_ack,
+        execute_at_commit=pt.execute_at_commit,
+        caesar_wait_condition=pt.caesar_wait_condition,
+    )
+
+
+def _exec_signature(spec, pdef, wl, env0, B: int, chunk_steps: int) -> str:
+    """Structural jaxpr signature of a bucket's megachunk driver program
+    at batch size B — the EXECUTABLE identity folded into the sweep-resume
+    fingerprint when an AOT cache is in play. Trace-only (no compile, no
+    execution): the same signature recipe the static checker pins
+    retrace-stable and the executable store keys on, so "results dir" and
+    "cached executable" can never silently disagree about which program
+    produced them."""
+    from ..analysis.rules import jaxpr_signature
+
+    env_b = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(
+            (B,) + tuple(np.shape(x)), np.asarray(x).dtype
+        ),
+        env0,
+    )
+    init, mega = sweep.make_megachunk_runner(spec, pdef, wl, chunk_steps)
+    st_sds = jax.eval_shape(init, env_b)
+    traced = mega.trace(env_b, st_sds)
+    return jaxpr_signature(traced.jaxpr, traced.jaxpr.in_avals)
+
+
 def run_grid(
     points: Sequence[Point],
     *,
@@ -310,6 +357,7 @@ def run_grid(
     resume: bool = False,
     stats: Optional[Dict[str, int]] = None,
     trace=None,
+    cache=None,
 ) -> List[str]:
     """Run every grid point and persist one results dir per shape bucket.
 
@@ -335,6 +383,13 @@ def run_grid(
     rendered timeline report (trace.json + trace.md, obs/report.py) is
     written next to it.
 
+    `cache` (a `fantoch_tpu.cache.ExecutableStore`) warm-starts the
+    chunked/megachunk drivers through the persistent AOT executable store
+    (compile once, later sweeps deserialize), and folds the bucket
+    program's structural jaxpr signature into the resume fingerprint —
+    resume then distinguishes "same grid, same EXECUTABLE" from "same
+    grid, changed program", exactly like the engine-parameter guard.
+
     Returns the created directories (load them with `ResultsDB.load` on the
     parent root)."""
     if metrics_log and not chunk_steps:
@@ -352,6 +407,87 @@ def run_grid(
     if stats is not None:
         stats.update({"buckets": len(buckets), "skipped": 0})
     for bi, (bkey, bpoints) in enumerate(sorted(buckets.items())):
+        pt0 = bpoints[0]
+        n = pt0.n
+        pregions = list(process_regions or [])
+        if not pregions:
+            pregions = [r for r in planet.regions()][:n]
+        assert len(pregions) >= n, "not enough regions for n processes"
+        pregions = pregions[:n]
+        C = len(client_regions) * pt0.clients_per_region
+        wl = pt0.workload()
+        total_cmds = C * pt0.commands_per_client
+        # GC window compaction for the protocols that support slot reuse:
+        # per-dot state (and the graph executor's closure) stays sized by
+        # the in-flight window; submits defer (never drop) under pressure.
+        # FPaxos/Caesar run unwindowed (static dot space).
+        fingerprint = _engine_fingerprint(pt0, C, trace)
+        max_seq = fingerprint["max_seq"]
+        pdef = make_protocol_def(
+            pt0.protocol,
+            n,
+            setup.command_key_slots(wl, pt0.batch_max_size),
+            max_seq=max_seq,
+            key_space_hint=wl.key_space(C),
+            nfr=pt0.nfr,
+            wait_condition=pt0.caesar_wait_condition,
+            clock_bump=pt0.tempo_clock_bump_interval_ms > 0,
+            buffer_detached=pt0.tempo_detached_send_interval_ms > 0,
+            skip_fast_ack=pt0.skip_fast_ack,
+            execute_at_commit=pt0.execute_at_commit,
+        )
+        leader = 1 if not pdef.leaderless else None
+        placement = setup.Placement(pregions, client_regions, pt0.clients_per_region)
+        config0 = _point_config(pt0, n, gc_interval_ms, leader)
+        spec = setup.build_spec(
+            config0,
+            wl,
+            pdef,
+            n_clients=C,
+            n_client_groups=len(client_regions),
+            max_seq=max_seq,
+            extra_ms=extra_ms,
+            max_steps=max_steps,
+            open_loop_interval_ms=pt0.open_loop_interval_ms or None,
+            batch_max_size=pt0.batch_max_size,
+            batch_max_delay_ms=pt0.batch_max_delay_ms,
+            # tighter in-flight bound for big sweeps (pool size is
+            # the per-event hot-op cost; drops abort via
+            # check_sim_health, so an undersized pool fails loudly)
+            pool_slots=pool_slots,
+            faults=pt0.fault_schedule() is not None,
+            faults_dup=pt0.dup_pct > 0,
+            deadline_ms=pt0.deadline_ms or None,
+            trace=trace,
+        )
+        # EXECUTABLE identity joins the resume fingerprint on chunked
+        # megachunk runs: trace-only (no compile) signature of the
+        # bucket's driver program — an engine/program change re-runs the
+        # bucket even when grid and engine params are unchanged, so
+        # cached results and cached executables can never silently
+        # disagree. STAMPED only on cache-enabled runs (a plain sweep
+        # must not pay a throwaway multi-second trace per bucket just to
+        # record metadata), VERIFIED whenever a candidate dir recorded
+        # one (so toggling --aot-cache off does not skip the identity
+        # check on dirs that carry it), and always LAZILY: a resume skip
+        # of a finished sweep stays a milliseconds-scale meta read per
+        # bucket — the signature is only derived when a candidate dir
+        # already matches every cheap field, or right before a
+        # cache-enabled run persists its meta. Dirs written without a
+        # cache carry no identity and resume on the cheap fields alone.
+        want_exec = bool(chunk_steps and not metrics_log)
+        exec_sig: Optional[str] = None
+
+        def bucket_exec_sig() -> str:
+            return _exec_signature(
+                spec, pdef, wl,
+                setup.build_env(
+                    spec, config0, planet, placement, wl, pdef,
+                    seed=pt0.seed, faults=pt0.fault_schedule(),
+                ),
+                len(bpoints), chunk_steps,
+            )
+
         if resume:
             # segment-safe restarts for long tunneled sweeps: every bucket
             # persists its own results dir (data.npz published atomically,
@@ -372,13 +508,20 @@ def run_grid(
                     # resuming across code changes that alter the sim
                     # (e.g. the ring-window policy) without changing the
                     # grid; absent in pre-fingerprint dirs -> re-run
-                    C_b = (
-                        len(client_regions) * bpoints[0].clients_per_region
-                    )
-                    if meta.get("searches") == want and meta.get(
-                        "engine_params"
-                    ) == _engine_fingerprint(bpoints[0], C_b, trace):
-                        done_dirs.append(d)
+                    meta_fp = meta.get("engine_params")
+                    if meta.get("searches") != want \
+                            or not isinstance(meta_fp, dict):
+                        continue
+                    cheap = {k: v for k, v in meta_fp.items()
+                             if k != "exec"}
+                    if cheap != fingerprint:
+                        continue
+                    if want_exec and "exec" in meta_fp:
+                        if exec_sig is None:
+                            exec_sig = bucket_exec_sig()
+                        if meta_fp["exec"] != exec_sig:
+                            continue
+                    done_dirs.append(d)
                 except (OSError, ValueError):
                     continue
             if done_dirs:
@@ -389,83 +532,18 @@ def run_grid(
                     print(f"bucket {bi}: resume skip -> {done_dirs[0]}",
                           flush=True)
                 continue
-        pt0 = bpoints[0]
-        n = pt0.n
-        pregions = list(process_regions or [])
-        if not pregions:
-            pregions = [r for r in planet.regions()][:n]
-        assert len(pregions) >= n, "not enough regions for n processes"
-        pregions = pregions[:n]
-        C = len(client_regions) * pt0.clients_per_region
-        wl = pt0.workload()
-        total_cmds = C * pt0.commands_per_client
-        # GC window compaction for the protocols that support slot reuse:
-        # per-dot state (and the graph executor's closure) stays sized by
-        # the in-flight window; submits defer (never drop) under pressure.
-        # FPaxos/Caesar run unwindowed (static dot space).
-        max_seq = _engine_fingerprint(pt0, C, trace)["max_seq"]
-        pdef = make_protocol_def(
-            pt0.protocol,
-            n,
-            setup.command_key_slots(wl, pt0.batch_max_size),
-            max_seq=max_seq,
-            key_space_hint=wl.key_space(C),
-            nfr=pt0.nfr,
-            wait_condition=pt0.caesar_wait_condition,
-            clock_bump=pt0.tempo_clock_bump_interval_ms > 0,
-            buffer_detached=pt0.tempo_detached_send_interval_ms > 0,
-            skip_fast_ack=pt0.skip_fast_ack,
-            execute_at_commit=pt0.execute_at_commit,
-        )
-        leader = 1 if not pdef.leaderless else None
-        placement = setup.Placement(pregions, client_regions, pt0.clients_per_region)
+        if want_exec and cache is not None:
+            # this bucket is going to RUN through the store: derive (or
+            # reuse) the exec identity so the persisted meta records
+            # which program produced the results
+            if exec_sig is None:
+                exec_sig = bucket_exec_sig()
+            fingerprint["exec"] = exec_sig
 
         envs = []
         searches = []
-        spec = None
         for pt in bpoints:
-            config = Config(
-                n=n, f=pt.f, gc_interval_ms=gc_interval_ms, leader=leader,
-                leader_check_interval_ms=(
-                    pt.leader_check_interval_ms or None
-                ),
-                nfr=pt.nfr,
-                tempo_tiny_quorums=pt.tempo_tiny_quorums,
-                tempo_clock_bump_interval_ms=(
-                    pt.tempo_clock_bump_interval_ms or None
-                ),
-                tempo_detached_send_interval_ms=(
-                    pt.tempo_detached_send_interval_ms or None
-                ),
-                executor_monitor_pending_interval_ms=(
-                    pt.executor_monitor_pending_interval_ms or None
-                ),
-                skip_fast_ack=pt.skip_fast_ack,
-                execute_at_commit=pt.execute_at_commit,
-                caesar_wait_condition=pt.caesar_wait_condition,
-            )
-            if spec is None:
-                spec = setup.build_spec(
-                    config,
-                    wl,
-                    pdef,
-                    n_clients=C,
-                    n_client_groups=len(client_regions),
-                    max_seq=max_seq,
-                    extra_ms=extra_ms,
-                    max_steps=max_steps,
-                    open_loop_interval_ms=pt0.open_loop_interval_ms or None,
-                    batch_max_size=pt0.batch_max_size,
-                    batch_max_delay_ms=pt0.batch_max_delay_ms,
-                    # tighter in-flight bound for big sweeps (pool size is
-                    # the per-event hot-op cost; drops abort via
-                    # check_sim_health, so an undersized pool fails loudly)
-                    pool_slots=pool_slots,
-                    faults=pt0.fault_schedule() is not None,
-                    faults_dup=pt0.dup_pct > 0,
-                    deadline_ms=pt0.deadline_ms or None,
-                    trace=trace,
-                )
+            config = _point_config(pt, n, gc_interval_ms, leader)
             envs.append(
                 setup.build_env(
                     spec, config, planet, placement, pt.workload(), pdef,
@@ -500,7 +578,7 @@ def run_grid(
                 # this path keeps the host-driven chunk loop (state donated
                 # in place; the snapshot reads the post-chunk state)
                 init, chunk, done = sweep.make_chunked_runner(
-                    spec, pdef, wl, chunk_steps
+                    spec, pdef, wl, chunk_steps, cache=cache
                 )
                 st = init(batched)
                 while not done(st):
@@ -516,7 +594,7 @@ def run_grid(
                 # chunks per device call, donated state, one int8 host sync
                 # per megachunk instead of a full-state pull per chunk
                 init, mega = sweep.make_megachunk_runner(
-                    spec, pdef, wl, chunk_steps
+                    spec, pdef, wl, chunk_steps, cache=cache
                 )
                 st = init(batched)
                 finished = 0
@@ -574,7 +652,7 @@ def run_grid(
                 extra_meta={
                     "process_regions": list(pregions),
                     "dstat": dstat,
-                    "engine_params": _engine_fingerprint(pt0, C, trace),
+                    "engine_params": fingerprint,
                 },
             )
         )

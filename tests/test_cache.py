@@ -1,0 +1,325 @@
+"""Persistent AOT executable cache (fantoch_tpu/cache).
+
+The cache's contract is asymmetric: a HIT must be invisible (a
+deserialized executable produces leaf-for-leaf bit-identical state vs a
+fresh compile, donation semantics included), and every failure — key
+miss, mismatched jax version, truncated payload, unserializable backend —
+must degrade to a plain compile, never to a wrong-executable reuse or an
+error. Both halves are pinned here, on the REAL drivers the bench and
+harness run (the donating vmapped megachunk runner, basic + the FPaxos
+leader protocol), plus the `python -m fantoch_tpu cache {warm,ls,purge}`
+CLI round trip.
+"""
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from fantoch_tpu.cache import CachedFn, ExecutableStore
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.planet import Planet
+from fantoch_tpu.core.workload import KeyGen, Workload
+from fantoch_tpu.engine import setup, sweep
+
+CHUNK = 150
+K = 3
+
+_BUILDS = {}
+
+
+def build(proto, cmds=8):
+    """Tiny 2-config batch (same shape recipe as test_sweep_megachunk)."""
+    if proto in _BUILDS:
+        return _BUILDS[proto]
+    from fantoch_tpu.protocols import basic, fpaxos
+
+    mod = {"basic": basic, "fpaxos": fpaxos}[proto]
+    planet = Planet.new()
+    leader = 1 if proto == "fpaxos" else None
+    config = Config(n=3, f=1, gc_interval_ms=100, leader=leader)
+    wl = Workload(1, KeyGen.conflict_pool(50, 2), 1, cmds, 100)
+    pdef = mod.make_protocol(3, 1)
+    spec = setup.build_spec(
+        config, wl, pdef, n_clients=2, n_client_groups=2,
+        max_steps=200_000, extra_ms=1000,
+        max_seq=12 if proto == "basic" else None,
+    )
+    placement = setup.Placement(
+        ["asia-east1", "us-central1", "us-west1"], ["us-west1", "us-west2"], 1
+    )
+    envs = sweep.stack_envs([
+        setup.build_env(spec, config, planet, placement, wl, pdef, seed=s)
+        for s in (0, 1)
+    ])
+    _BUILDS[proto] = (spec, pdef, wl, envs)
+    return _BUILDS[proto]
+
+
+def drive(proto, cache):
+    """Full run through the DONATING megachunk sweep runner; returns the
+    final state as numpy."""
+    spec, pdef, wl, envs = build(proto)
+    init, mega = sweep.make_megachunk_runner(
+        spec, pdef, wl, CHUNK, k=K, cache=cache
+    )
+    st = init(envs)
+    done = 0
+    n = 0
+    while not done:
+        st, d = mega(envs, st)
+        done = int(d)
+        n += 1
+        assert n < 1000
+    return jax.tree_util.tree_map(np.asarray, st)
+
+
+def assert_states_equal(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(x, y)
+
+
+# session-shared reference states (one compile per protocol, reused by
+# every test below — compiles dominate on this 1-core host)
+_REF = {}
+
+
+def reference(proto):
+    if proto not in _REF:
+        _REF[proto] = drive(proto, None)
+    return _REF[proto]
+
+
+# ---------------------------------------------------------------------------
+# round-trip bit-identity
+# ---------------------------------------------------------------------------
+
+
+# fpaxos rides the slow tier: the store compiles its entries natively
+# (the native-cache bypass in store._compile is deliberate), so each
+# protocol costs real compile seconds on this 1-core host — basic keeps
+# the contract pinned in tier-1, the leader protocol doubles coverage in
+# the unfiltered tier
+@pytest.mark.parametrize("proto", [
+    "basic", pytest.param("fpaxos", marks=pytest.mark.slow),
+])
+def test_roundtrip_bit_identity(proto, tmp_path):
+    """Cold store populates (misses), a FRESH store over the same dir
+    deserializes (hits), and both states match the no-cache reference
+    leaf for leaf — including through donation (the megachunk runner
+    donates its state argument in all three runs)."""
+    root = str(tmp_path / "aot")
+    ref = reference(proto)
+
+    cold = ExecutableStore(root)
+    st_cold = drive(proto, cold)
+    assert cold.misses >= 2 and cold.hits == 0, cold.stats()  # init + mega
+    assert_states_equal(ref, st_cold)
+
+    warm = ExecutableStore(root)  # a new process would build exactly this
+    st_warm = drive(proto, warm)
+    assert warm.hits >= 2 and warm.misses == 0, warm.stats()
+    assert warm.corrupt == 0
+    assert_states_equal(ref, st_warm)
+
+    # entries carry the metadata `cache ls` renders
+    metas = warm.entries()
+    assert {m["program"] for m in metas} == {"sweep.init", "sweep.megachunk"}
+    for m in metas:
+        assert m["protocol"] == proto
+        assert m["present"] and m["size"] > 0
+        assert m["jax"] == jax.__version__
+
+
+def test_corrupted_entry_falls_back_to_compile(tmp_path):
+    """A truncated payload must read as corrupt -> recompile (+ rewrite),
+    with the final state still bit-identical — never a partial load."""
+    root = str(tmp_path / "aot")
+    ref = reference("basic")
+    drive("basic", ExecutableStore(root))
+
+    exes = sorted(
+        os.path.join(root, f) for f in os.listdir(root) if f.endswith(".exe")
+    )
+    assert exes
+    with open(exes[0], "r+b") as f:
+        f.truncate(64)
+
+    store = ExecutableStore(root)
+    st = drive("basic", store)
+    assert store.corrupt == 1, store.stats()
+    assert store.misses == 1  # the corrupt entry recompiled...
+    assert store.hits == 1  # ...the intact one loaded
+    assert_states_equal(ref, st)
+
+    # the recompile overwrote the bad entry: next store hits clean
+    again = ExecutableStore(root)
+    assert_states_equal(ref, drive("basic", again))
+    assert again.hits >= 2 and again.corrupt == 0, again.stats()
+
+
+def test_mismatched_jax_version_is_a_miss(tmp_path):
+    """A store pinned to a different jax version string must MISS against
+    entries written by the real one (the key embeds the version) and fall
+    back to a clean compile."""
+    root = str(tmp_path / "aot")
+    ref = reference("basic")
+    drive("basic", ExecutableStore(root))
+
+    other = ExecutableStore(root, jax_version="0.0.0-mismatch")
+    st = drive("basic", other)
+    assert other.hits == 0 and other.misses >= 2, other.stats()
+    assert other.corrupt == 0  # a miss, not a bad load
+    assert_states_equal(ref, st)
+
+
+def test_unserializable_backend_degrades_to_plain_compile(tmp_path,
+                                                          monkeypatch):
+    """A backend that cannot serialize executables must not pay the
+    native-cache-bypassing fresh compile on every miss forever: the first
+    miss learns the verdict (counter + persisted meta marker, no .exe),
+    and every later miss — in-process or in a fresh store — goes straight
+    through the normal compile path."""
+    import jax.numpy as jnp
+    from jax.experimental import serialize_executable as se
+
+    def boom(compiled):
+        raise ValueError("backend refuses serialization")
+
+    monkeypatch.setattr(se, "serialize", boom)
+    jitted = jax.jit(lambda x: x + 1)
+    arg = jnp.zeros((4,), jnp.int32)
+    root = str(tmp_path / "aot")
+
+    s1 = ExecutableStore(root)
+    compiled, i1 = s1.get_or_compile(jitted, (arg,), program="toy")
+    assert np.asarray(compiled(arg)).tolist() == [1, 1, 1, 1]
+    assert s1.unserializable == 1 and "unserializable" in i1
+
+    # in-process: the verdict is remembered, not re-discovered
+    _, i2 = s1.get_or_compile(jitted, (arg,), program="toy")
+    assert i2["unserializable"] == "marked"
+    assert s1.unserializable == 1  # no second serialize attempt
+
+    # cross-process: the meta marker (present: false, no .exe) persists it
+    s2 = ExecutableStore(root)
+    _, i3 = s2.get_or_compile(jitted, (arg,), program="toy")
+    assert i3["unserializable"] == "marked"
+    assert s2.hits == 0 and s2.corrupt == 0 and s2.misses == 1
+    (meta,) = s2.entries()
+    assert meta["unserializable"] and not meta["present"]
+
+
+def test_cached_fn_survives_store_failure(tmp_path, monkeypatch):
+    """Cache machinery must never take execution down: a store whose
+    get_or_compile raises degrades the wrapper to the plain jitted
+    callable, results intact."""
+    store = ExecutableStore(str(tmp_path / "aot"))
+
+    def boom(*a, **k):
+        raise OSError("disk on fire")
+
+    monkeypatch.setattr(store, "get_or_compile", boom)
+    spec, pdef, wl, envs = build("basic")
+    init, mega = sweep.make_megachunk_runner(
+        spec, pdef, wl, CHUNK, k=K, cache=store
+    )
+    assert isinstance(mega, CachedFn)
+    st = init(envs)
+    st, _d = mega(envs, st)  # falls back, still runs
+    assert mega.info and "error" in mega.info
+    assert int(np.asarray(st.step).sum()) > 0
+
+
+# ---------------------------------------------------------------------------
+# harness: warm-started sweeps + executable identity in resume fingerprints
+# ---------------------------------------------------------------------------
+
+
+def test_run_grid_cache_and_resume_exec_identity(tmp_path):
+    """`run_grid(cache=...)` resolves the bucket's megachunk driver
+    through the store AND records the program's structural signature in
+    the bucket meta; a resume run skips the bucket only while that
+    executable identity matches (a changed program re-runs instead of
+    resuming foreign results)."""
+    import json as _json
+
+    from fantoch_tpu.exp.harness import Point, run_grid
+
+    root = str(tmp_path / "results")
+    store = ExecutableStore(str(tmp_path / "aot"))
+    pts = [Point(protocol="basic", n=3, f=1, clients_per_region=1,
+                 conflict_rate=100, commands_per_client=5, seed=s)
+           for s in (0, 1)]
+    dirs = run_grid(pts, results_root=root, name="cgrid", chunk_steps=200,
+                    cache=store)
+    assert store.misses >= 2 and store.hits == 0, store.stats()
+    with open(os.path.join(dirs[0], "meta.json")) as f:
+        meta = _json.load(f)
+    sig = meta["engine_params"].get("exec")
+    assert sig and len(sig) == 16, meta["engine_params"]
+
+    # resume: identical grid + identical executable identity -> skip
+    stats = {}
+    dirs2 = run_grid(pts, results_root=root, name="cgrid", chunk_steps=200,
+                     cache=store, resume=True, stats=stats)
+    assert stats["skipped"] == 1 and dirs2 == dirs
+
+    # a bucket recorded under a DIFFERENT executable identity must not be
+    # resumed from: tamper the persisted signature (the cheap stand-in
+    # for "the program changed since these results were produced") and
+    # the resume re-runs — through the store, so the re-run is all hits
+    meta["engine_params"]["exec"] = "0" * 16
+    with open(os.path.join(dirs[0], "meta.json"), "w") as f:
+        _json.dump(meta, f)
+    h0, stats3 = store.hits, {}
+    run_grid(pts, results_root=root, name="cgrid", chunk_steps=200,
+             cache=store, resume=True, stats=stats3)
+    assert stats3["skipped"] == 0
+    assert store.hits > h0  # the re-run warm-started from the store
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def test_cli_cache_warm_ls_purge(capsys, tmp_path):
+    """`cache warm` AOT-compiles the lint-matrix driver programs into the
+    store; a second warm is all hits; `ls --json` lists the entries;
+    `purge` empties the store."""
+    from fantoch_tpu.__main__ import main
+
+    d = str(tmp_path / "aot")
+    args = ["cache", "warm", "--dir", d, "--protocols", "basic",
+            "--engines", "sweep", "--trace", "off"]
+    rc = main(args)
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["warmed"] >= 2  # megachunk + the non-donating chunked runner
+    assert out["stats"]["misses"] == out["warmed"]
+
+    rc = main(args)  # second warm: pure deserialization
+    assert rc == 0
+    out2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out2["stats"]["hits"] == out["warmed"]
+    assert out2["stats"]["misses"] == 0
+
+    rc = main(["cache", "ls", "--dir", d, "--json"])
+    assert rc == 0
+    ls = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert len(ls["entries"]) == out["warmed"]
+    assert {m["protocol"] for m in ls["entries"]} == {"basic"}
+
+    rc = main(["cache", "purge", "--dir", d])
+    assert rc == 0
+    purged = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert purged["purged"] == out["warmed"]
+    rc = main(["cache", "ls", "--dir", d, "--json"])
+    assert rc == 0
+    assert json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1]
+    )["entries"] == []

@@ -68,6 +68,26 @@ def test_lint_full_matrix_clean():
     assert len(report["programs"]) == 6 * (8 + 2 + 4) + 2
 
 
+@pytest.mark.slow
+def test_lint_aot_alias_verification_clean(tmp_path):
+    """The compiled executables' ACTUAL input_output_aliases agree with
+    the static donation verdict for every protocol's donating drivers and
+    basic's forbidden-donation chunked runner (the ROADMAP follow-up the
+    AOT cache makes affordable). Routed through an executable store so a
+    re-run of this test deserializes instead of recompiling."""
+    from fantoch_tpu.cache import ExecutableStore
+
+    store = ExecutableStore(str(tmp_path / "aot"))
+    report = checker.lint(
+        engines=["lockstep", "sweep"],
+        trace_variants=(False,), fault_variants=(False,),
+        retrace=False, aot_alias=True, aot_store=store,
+    )
+    assert report["violations"] == [], report["violations"]
+    # every donation-contracted program actually compiled + verified
+    assert store.misses >= 6 * 3 + 1  # chunk+mega+sweep.mega x6 + chunked
+
+
 # ---------------------------------------------------------------------------
 # negative: purity
 # ---------------------------------------------------------------------------
@@ -261,6 +281,114 @@ def test_donation_flags_forbidden_donation():
     )
     vs = rules.DonationRule().check(prog)
     assert [v.rule for v in vs] == ["donation/forbidden"]
+
+
+# ---------------------------------------------------------------------------
+# negative: executable alias verification (AOT)
+# ---------------------------------------------------------------------------
+
+
+def test_executable_alias_mismatch_detected():
+    """The compiled-executable check must catch a donation contract that
+    diverged between trace and compile: a program whose traced side
+    expects a donated state but whose executable was built WITHOUT
+    donation (zero alias pairs) is flagged; the honestly-donating build
+    passes."""
+
+    def f(st):
+        return {"a": st["a"] + 1}
+
+    arg = {"a": jnp.zeros((4,), jnp.int32)}
+    donating = jax.jit(f, donate_argnums=(0,))
+    traced = donating.trace(arg)
+
+    good = checker.program_from_traced(
+        traced, name="toy.alias-good", kind="toy", expect_donation=True,
+        aot_fn=checker.make_aot_fn(donating, (arg,), program="toy"),
+    )
+    assert rules.check_executable_aliases(good) == []
+
+    bad = checker.program_from_traced(
+        traced, name="toy.alias-bad", kind="toy", expect_donation=True,
+        # the executable is compiled from the NON-donating jit: its
+        # input_output_alias set is empty while the traced contract
+        # donates one leaf
+        aot_fn=checker.make_aot_fn(jax.jit(f), (arg,), program="toy"),
+    )
+    vs = rules.check_executable_aliases(bad)
+    assert [v.rule for v in vs] == ["donation/executable-alias"]
+    assert "aliases 0" in vs[0].detail and "expects 1" in vs[0].detail
+
+    # forbid_donation is the inverse: an executable that aliases anything
+    # violates the checkpointing contract
+    forbid = checker.program_from_traced(
+        jax.jit(f).trace(arg), name="toy.alias-forbid", kind="toy",
+        forbid_donation=True,
+        aot_fn=checker.make_aot_fn(donating, (arg,), program="toy"),
+    )
+    vs2 = rules.check_executable_aliases(forbid)
+    assert [v.rule for v in vs2] == ["donation/executable-alias"]
+
+
+# ---------------------------------------------------------------------------
+# negative: HLO size budgets
+# ---------------------------------------------------------------------------
+
+
+def _engine_toy(name="toy.sized"):
+    """A toy program posing as an engine program (HloSizeRule exempts
+    engine '?' — synthetic programs are unbudgeted by design)."""
+    traced = jax.jit(lambda x: x * 2 + 1).trace(jnp.zeros((4,), jnp.int32))
+    prog = checker.program_from_traced(traced, name=name, kind="toy")
+    prog.engine = "lockstep"
+    return prog
+
+
+def test_hlo_size_flags_regression_over_budget():
+    prog = _engine_toy()
+    assert prog.eqn_count >= 2
+    # budget below the slack line -> regression; at/above it -> clean
+    tight = rules.HloSizeRule(budgets={prog.name: prog.eqn_count - 1},
+                              slack=0.0)
+    vs = tight.check(prog)
+    assert [v.rule for v in vs] == ["hlo-size/regression"]
+    assert "--update-budgets" in vs[0].detail or "re-baseline" in vs[0].detail
+    ok = rules.HloSizeRule(budgets={prog.name: prog.eqn_count})
+    assert ok.check(prog) == []
+    # the slack is real: a budget 10% under the current count still passes
+    prog10 = _engine_toy("toy.sized10")
+    under = rules.HloSizeRule(budgets={prog10.name: 10}, slack=0.10)
+    prog10.eqn_count = 11
+    assert under.check(prog10) == []
+    prog10.eqn_count = 12
+    assert [v.rule for v in under.check(prog10)] == ["hlo-size/regression"]
+
+
+def test_hlo_size_flags_unbudgeted_engine_program():
+    """An engine program with NO committed budget must fail (the manifest
+    covers every shipped program; --update-budgets is the escape hatch) —
+    while synthetic programs stay exempt."""
+    prog = _engine_toy("toy.unbudgeted")
+    vs = rules.HloSizeRule(budgets={}).check(prog)
+    assert [v.rule for v in vs] == ["hlo-size/unbudgeted"]
+    assert "--update-budgets" in vs[0].detail
+
+    toy = checker.program_from_traced(
+        jax.jit(lambda x: x + 1).trace(jnp.int32(0)),
+        name="toy.exempt", kind="toy",
+    )
+    assert rules.HloSizeRule(budgets={}).check(toy) == []
+
+
+def test_hlo_size_manifest_covers_fast_subset():
+    """The committed manifest (analysis/hlo_budgets.json) actually budgets
+    the programs the tier-1 fast subset traces — the rule is live, not
+    vacuously skipping on missing entries."""
+    budgets = rules.load_hlo_budgets()
+    assert budgets, "hlo_budgets.json missing or empty"
+    programs = checker.lockstep_programs("basic", trace=False, faults=None)
+    for p in programs:
+        assert p.name in budgets, p.name
 
 
 # ---------------------------------------------------------------------------

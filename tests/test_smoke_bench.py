@@ -19,10 +19,16 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PROTOCOLS = {"basic", "tempo", "atlas", "epaxos", "fpaxos", "caesar"}
 
 
-def test_bench_smoke_all_six_protocols():
+def test_bench_smoke_all_six_protocols(tmp_path):
     env = dict(os.environ)
     env.pop("BENCH_PROTOCOLS", None)  # the smoke must cover all six
     env.setdefault("BENCH_BUDGET_S", "540")
+    # pin the AOT executable store ON and ISOLATED: the cache assertions
+    # below must not depend on the caller's BENCH_AOT or on whatever a
+    # previous run left in the shared repo-level store — a cold tmp store
+    # exercises the full prime (write) -> timed (load) path every run
+    env["BENCH_AOT"] = "1"
+    env["FANTOCH_AOT_CACHE"] = str(tmp_path / "aot")
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--smoke"],
         capture_output=True, text=True, timeout=660, cwd=REPO, env=env,
@@ -55,6 +61,28 @@ def test_bench_smoke_all_six_protocols():
         assert tr["totals"]["done"] > 0, (name, tr)
         assert tr["totals"]["commit"] > 0, (name, tr)
         assert tr["windows_active"] > 0, (name, tr)
+        # the compile/run split + AOT store counters ride every record:
+        # each protocol's timed programs resolved through the executable
+        # store (hit = deserialized, miss = compiled + persisted) — on any
+        # store state hits + misses >= the two driver programs
+        assert rec["run_s"] == rec["wall_s"], (name, rec)
+        assert rec["compile_s"] > 0, (name, rec)
+        cache = rec.get("cache")
+        assert cache, (name, "missing cache record")
+        assert cache["hits"] + cache["misses"] >= 2, (name, cache)
+        assert cache["corrupt"] == 0, (name, cache)
+
+    # the golden phase primed basic's timed executables into the store
+    # inside its side budget, so basic's timed slice LOADED them — the
+    # warm-start path is live even on a cold store (a second smoke run
+    # hits for every protocol; asserted by the CI workflow). Priming is
+    # best-effort by design (budget-gated): only a prime that actually
+    # RAN obliges the timed slice to hit — a budget-skipped prime on a
+    # slow host must not turn into a red test with no product bug.
+    basic = last["per_protocol"]["basic"]
+    primed = basic.get("primed")
+    if primed and not primed.get("error"):
+        assert basic["cache"]["hits"] >= 1, basic
 
     # the static contract checker's digest rides the smoke aggregate (the
     # CI face of `python -m fantoch_tpu lint`): a missing or failed digest
@@ -64,7 +92,7 @@ def test_bench_smoke_all_six_protocols():
     assert lint["ok"] is True and lint["violations"] == 0, lint
     assert lint["programs"] > 0
     assert set(lint["rules"]) == {"purity", "dtype", "donation",
-                                  "static-keys"}
+                                  "static-keys", "hlo-size"}
 
     # incremental aggregates: at least one partial line must precede the
     # final one (the crash-containment property the round-4/5 benches

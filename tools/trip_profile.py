@@ -18,7 +18,11 @@ events/sec, and compiled HLO line counts of both programs — the
 measurement behind the bench's O(chunks) -> O(megachunks) host-sync claim.
 It also runs a TRACE-ENABLED megachunk (obs/trace.py) and FAILS if the
 trace recorder added a single host sync — the device-residency proof of
-the windowed trace subsystem.
+the windowed trace subsystem. Each driver record carries the
+`first_call_s` (trace+compile+first execution) vs `warm_dispatch_s`
+(compiled re-dispatch) split, so compile cost — the number the AOT
+executable cache (fantoch_tpu/cache) exists to amortize — is a tracked
+measurement, not a residue folded into trip times.
 
 Usage:  python tools/trip_profile.py [tempo] [--batches 64,256,1024]
         python tools/trip_profile.py tempo --drivers [--batch 64] [--mega-k 4]
@@ -143,8 +147,16 @@ def compare_drivers(name, B=64, chunk_steps=None, k=4, cmds=25):
     jax.block_until_ready(st0)
     # warm BEFORE hlo_lines: the jit call writes the persistent compile
     # cache, so lower().compile() (a separate AOT compile) deserializes
-    # instead of re-compiling the ~100k-line program from scratch
+    # instead of re-compiling the ~100k-line program from scratch.
+    # The warm call's wall IS the compile cost; a second dispatch on the
+    # same (non-donated) state times the compiled program alone — the
+    # first_call/warm split the executable cache's win is measured in.
+    t0 = time.time()
     jax.block_until_ready(chunk(envs, st0))
+    first_call_s = time.time() - t0
+    t0 = time.time()
+    jax.block_until_ready(chunk(envs, st0))
+    warm_dispatch_s = time.time() - t0
     chlo = hlo_lines(chunk, envs, st0)
     t0 = time.time()
     st = init(envs)
@@ -162,6 +174,8 @@ def compare_drivers(name, B=64, chunk_steps=None, k=4, cmds=25):
         "events": ev,
         "events_per_sec": round(ev / max(dt, 1e-9), 1),
         "hlo_lines": chlo,
+        "first_call_s": round(first_call_s, 3),
+        "warm_dispatch_s": round(warm_dispatch_s, 3),
     }
 
     # device-resident megachunk driver (one int8 host sync per k chunks,
@@ -171,18 +185,24 @@ def compare_drivers(name, B=64, chunk_steps=None, k=4, cmds=25):
         minit, mega = sweep.make_megachunk_runner(mspec, pdef, wl, cs, k=k)
         mst0 = minit(envs)
         jax.block_until_ready(mst0)
+        t0 = time.time()
         wst, wd = mega(envs, mst0)  # warm (donates mst0)
         jax.block_until_ready(wst)
+        first_call_s = time.time() - t0
         del wst, wd
         mhlo = hlo_lines(mega, envs, minit(envs))
         t0 = time.time()
         mst = minit(envs)
         m = 0
         fin = 0
+        warm_dispatch_s = None
         while not fin:
+            it0 = time.time()
             mst, d = mega(envs, mst)
             m += 1
-            fin = int(d)
+            fin = int(d)  # pulls the int8 — syncs the dispatch
+            if warm_dispatch_s is None:
+                warm_dispatch_s = time.time() - it0
         jax.block_until_ready(mst)
         mdt = time.time() - t0
         mev = int(np.asarray(mst.step).sum())
@@ -193,6 +213,11 @@ def compare_drivers(name, B=64, chunk_steps=None, k=4, cmds=25):
             "events": mev,
             "events_per_sec": round(mev / max(mdt, 1e-9), 1),
             "hlo_lines": mhlo,
+            # first_call folds trace+compile+one megachunk execution;
+            # warm_dispatch is the same megachunk re-dispatched compiled —
+            # the difference is what the AOT store saves a cold process
+            "first_call_s": round(first_call_s, 3),
+            "warm_dispatch_s": round(warm_dispatch_s, 3),
         }, mev, mdt, (minit, mega)
 
     m, out["megachunk"], mev, mdt, _ = timed_mega(spec)
