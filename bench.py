@@ -71,6 +71,16 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+if "--serve-smoke" in sys.argv[1:]:
+    # the serve smoke needs one CPU device per consensus process: force
+    # the virtual host mesh BEFORE jax initializes (the shared dance —
+    # importing fantoch_tpu.__main__ does not initialize jax), and ride
+    # the smoke shapes/backend rules
+    os.environ["BENCH_SMOKE"] = "1"
+    from fantoch_tpu.__main__ import _force_host_mesh
+
+    _force_host_mesh()
+
 import jax
 import numpy as np
 
@@ -656,15 +666,21 @@ def active_runs():
 # warm worker (child side)
 # ---------------------------------------------------------------------------
 
-def prime_protocol(name):
+def prime_protocol(name, store=None):
     """AOT-prime `name`'s timed-run programs into the executable store
     during the golden side budget: trace + compile (or load) the EXACT
     megachunk/init programs `timed_run` will dispatch — executable
     identity is the structural jaxpr signature, so the shapes here must
     match the timed path bit-for-bit (same build_batch, same MEGA_K).
     Returns the store-counter delta, or None when priming is off/skipped.
-    Priming never fails the golden: any error is reported and swallowed."""
-    store = _aot_store()
+    Priming never fails the golden: any error is reported and swallowed.
+
+    `store` overrides the bench's own store handle — `python -m
+    fantoch_tpu cache warm --bench-shapes` primes through here from
+    OUTSIDE the bench process (a serving worker or CI pre-warms without
+    running a golden phase)."""
+    if store is None:
+        store = _aot_store()
     # the guard must sit BELOW the parent's minimum prime slice (45 s), or
     # floor-slice primes set an op deadline the guard immediately rejects
     # and priming silently dead-bands exactly in tight-budget runs
@@ -1174,9 +1190,56 @@ def main():
                          lint=lint_digest), flush=True)
 
 
+def serve_smoke_main():
+    """Tiny streaming-ingress serve on the CPU backend through the AOT
+    store — the CI/tier-1 face of the serving path (fantoch_tpu/ingress):
+    one parseable JSON line with nonzero completions, zero stall aborts,
+    one host sync per megachunk, and the store's hit/miss counters (a
+    warm second run must report hits > 0 for the serve program)."""
+    jax.config.update("jax_platforms", "cpu")
+    from fantoch_tpu.exp.serve import run_serve
+
+    store = _aot_store()
+    t0 = time.time()
+    try:
+        rep = run_serve(
+            "basic", 3, 1,
+            logical_clients=int(os.environ.get("SERVE_SMOKE_CLIENTS", "64")),
+            commands_per_client=2,
+            interval_ms=50,
+            rifl_window=16,
+            ring_slots=64,
+            mega_k=2,
+            window_ms=100,
+            clients_per_region=2,
+            key_space=32,
+            stall_gap_ms=15000,
+            max_wall_s=float(os.environ.get("SERVE_SMOKE_WALL_S", "420")),
+            cache=store,
+        )
+    except Exception as e:  # noqa: BLE001 — one parseable error line
+        print(json.dumps(
+            {"serve_smoke": True,
+             "error": f"{type(e).__name__}: {e}"[:500]}
+        ), flush=True)
+        return 1
+    rep["serve_smoke"] = True
+    rep["wall_total_s"] = round(time.time() - t0, 1)
+    # trim the bulky series out of the one-line aggregate
+    for k in ("telemetry", "completions_per_window", "done_per_window"):
+        rep.pop(k, None)
+    print(json.dumps(rep), flush=True)
+    ok = (rep.get("completed", 0) > 0 and not rep.get("stall_abort")
+          and not rep.get("aborted") and rep.get("issued") ==
+          rep.get("completed"))
+    return 0 if ok else 1
+
+
 if __name__ == "__main__":
     if "--worker" in sys.argv[1:]:
         sys.exit(worker_main())
+    if "--serve-smoke" in sys.argv[1:]:
+        sys.exit(serve_smoke_main())
     if "--smoke" in sys.argv[1:]:
         SMOKE = True
         os.environ["BENCH_SMOKE"] = "1"  # inherited by the worker

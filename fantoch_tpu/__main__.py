@@ -254,21 +254,96 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def _force_host_mesh() -> None:
+    """The quantum runner needs one device per process (n <= 8): force a
+    virtual host mesh BEFORE jax initializes — a no-op if the flag is
+    already set or jax is already imported (then the caller owns the
+    device topology)."""
+    if "jax" in sys.modules:
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+
+def cmd_serve(args) -> int:
+    """Streaming ingress serve run (fantoch_tpu/ingress + exp/serve.py):
+    replay a synthetic open-loop trace — or a line-JSON file feed —
+    through the quantum runner's serving mode and print the report JSON
+    (commands/sec/chip, p50/p99 ingress-to-done latency off the bucketed
+    trace channel, host-syncs-per-megachunk, backpressure counters)."""
+    _force_host_mesh()
+
+    from .exp import serve as serve_mod
+
+    cache = None
+    if args.aot_cache:
+        from .cache import ExecutableStore, ensure_native_cache
+
+        ensure_native_cache()
+        cache = ExecutableStore(args.aot_cache_dir or None)
+    feed = None
+    if args.feed:
+        if not args.max_commands:
+            # the dot-space bound cannot be derived from an external
+            # feed (it would have to be read twice): demand it
+            print("serve: --feed needs an explicit --max-commands"
+                  " (the dot-space bound; >= the feed's total merged"
+                  " submits)", file=sys.stderr)
+            return 2
+        from .ingress import file_feed
+
+        feed = file_feed(args.feed)
+    try:
+        report = serve_mod.run_serve(
+            args.protocol, args.n, args.f,
+            logical_clients=args.clients,
+            commands_per_client=args.commands,
+            interval_ms=args.interval,
+            read_only_pct=args.read_only,
+            feed=feed,
+            clients_per_region=args.client_slots,
+            client_regions=_csv(args.client_regions) or None,
+            process_regions=_csv(args.process_regions) or None,
+            rifl_window=args.rifl_window,
+            keys_per_command=args.keys_per_command,
+            key_space=args.key_space,
+            batch=args.batch,
+            batch_delay_ms=args.batch_delay,
+            ring_slots=args.ring_slots,
+            mega_k=args.mega_k,
+            window_ms=args.window,
+            max_commands=args.max_commands or None,
+            trace_windows=args.trace_windows,
+            stall_gap_ms=args.stall_gap,
+            overflow=args.overflow,
+            max_queue=args.max_queue,
+            max_wall_s=args.max_wall_s or None,
+            max_megachunks=args.max_megachunks or None,
+            seed=args.seed,
+            cache=cache,
+        )
+    except Exception as e:  # noqa: BLE001 — one parseable error line
+        print(json.dumps({"error": f"{type(e).__name__}: {e}"[:500]}))
+        return 1
+    print(json.dumps(report))
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(json.dumps(report))
+        print(f"json: {args.json_out}", file=sys.stderr)
+    # nonzero exit on an aborted serve so CI/scripts can gate on it
+    return 0 if not report.get("aborted") else 1
+
+
 def cmd_lint(args) -> int:
     """Static engine-contract checker (fantoch_tpu/analysis): trace the
     jitted engine programs for the requested protocol x engine x trace x
     faults matrix and verify purity, dtype discipline, donation safety and
     recompile-key hygiene. Exit 1 on any violation; `--json` prints the
     full machine-readable report."""
-    # the quantum runner needs one device per process (n=3): force a
-    # virtual host mesh BEFORE jax initializes (no-op if already set or if
-    # jax is already imported — then the caller owns the device topology)
-    if "jax" not in sys.modules:
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=8"
-            ).strip()
+    _force_host_mesh()
 
     from .analysis import checker
 
@@ -395,6 +470,40 @@ def cmd_cache(args) -> int:
     import time as _time
 
     ensure_native_cache()
+    if args.bench_shapes:
+        # prime the bench's EXACT timed-shape programs (the one shape
+        # resolver bench.timed_shapes + timed_batch + MEGA_K) without
+        # running a bench golden phase — a serving worker or CI pre-warms
+        # the store from here; executable identity is the structural
+        # jaxpr signature, so these entries are bit-for-bit the ones the
+        # timed bench will look up
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if repo not in sys.path:
+            sys.path.insert(0, repo)
+        if args.smoke:
+            os.environ["BENCH_SMOKE"] = "1"
+        import bench
+
+        names = _csv(args.protocols) or [r[0] for r in bench.active_runs()]
+        unknown = set(names) - {r[0] for r in bench.active_runs()}
+        if unknown:
+            print(f"cache warm: unknown bench protocols {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+        primed = {}
+        for name in names:
+            t0 = _time.time()
+            primed[name] = {
+                "delta": bench.prime_protocol(name, store=store),
+                "wall_s": round(_time.time() - t0, 2),
+            }
+            if args.verbose:
+                print(f"cache warm: bench[{name}] {primed[name]}",
+                      file=sys.stderr)
+        out = {"root": store.root, "bench_shapes": primed,
+               "stats": store.stats()}
+        print(json.dumps(out))
+        return 0
     from .analysis import checker
 
     protocols = _csv(args.protocols) or list(checker.PROTOCOLS)
@@ -763,6 +872,69 @@ def main(argv=None) -> int:
                          " first-divergence window")
     pt.set_defaults(fn=cmd_trace)
 
+    pv = sub.add_parser(
+        "serve",
+        help="streaming ingress: replay a synthetic open-loop trace (or a"
+             " line-JSON feed) through the quantum runner's serving mode,"
+             " print the serve report JSON",
+    )
+    pv.add_argument("--protocol", default="basic")
+    pv.add_argument("--n", type=int, default=3)
+    pv.add_argument("--f", type=int, default=1)
+    pv.add_argument("--clients", type=int, default=1000,
+                    help="logical open-loop clients of the synthetic trace")
+    pv.add_argument("--commands", type=int, default=1,
+                    help="commands per logical client")
+    pv.add_argument("--interval", type=int, default=100,
+                    help="open-loop interval ms of the synthetic trace")
+    pv.add_argument("--read-only", type=int, default=0)
+    pv.add_argument("--feed", default="",
+                    help="line-JSON command feed file instead of the"
+                         " synthetic trace ({'t','client','keys','ro'})")
+    pv.add_argument("--client-slots", type=int, default=2,
+                    help="device client slots per region (logical clients"
+                         " multiplex onto them)")
+    pv.add_argument("--client-regions", default="")
+    pv.add_argument("--process-regions", default="")
+    pv.add_argument("--rifl-window", type=int, default=64,
+                    help="per-slot in-flight rifl window (backpressure)")
+    pv.add_argument("--keys-per-command", type=int, default=1)
+    pv.add_argument("--key-space", type=int, default=64)
+    pv.add_argument("--batch", type=int, default=1,
+                    help="host batcher merge size (ingress-side batching;"
+                         " the runner contract stays B=1)")
+    pv.add_argument("--batch-delay", type=int, default=0,
+                    help="host batcher max delay ms")
+    pv.add_argument("--ring-slots", type=int, default=256)
+    pv.add_argument("--mega-k", type=int, default=4,
+                    help="ingress windows per device call (megachunk)")
+    pv.add_argument("--window", type=int, default=100,
+                    help="ingress window / telemetry bin ms")
+    pv.add_argument("--max-commands", type=int, default=0,
+                    help="dot-space bound (0 = derive from the synthetic"
+                         " trace; REQUIRED with --feed)")
+    pv.add_argument("--max-megachunks", type=int, default=0,
+                    help="bound the serve to this many device calls"
+                         " (0 = run to completion)")
+    pv.add_argument("--trace-windows", type=int, default=256)
+    pv.add_argument("--stall-gap", type=int, default=15000,
+                    help="liveness alarm: abort after this much simulated"
+                         " ms without a completion while work is pending")
+    pv.add_argument("--overflow", choices=["defer", "drop"],
+                    default="defer",
+                    help="bounded-queue policy when the stream outruns"
+                         " the device")
+    pv.add_argument("--max-queue", type=int, default=100_000)
+    pv.add_argument("--max-wall-s", type=float, default=0.0)
+    pv.add_argument("--seed", type=int, default=0)
+    pv.add_argument("--aot-cache", action="store_true",
+                    help="warm-start the serve program through the"
+                         " persistent AOT executable store")
+    pv.add_argument("--aot-cache-dir", default="")
+    pv.add_argument("--json", default="", dest="json_out",
+                    help="also write the report JSON here")
+    pv.set_defaults(fn=cmd_serve)
+
     pl = sub.add_parser(
         "lint",
         help="static engine-contract checker: trace the jitted programs,"
@@ -810,6 +982,14 @@ def main(argv=None) -> int:
                     help="warm: CSV of lockstep,sweep (default: both)")
     pc.add_argument("--trace", default="off",
                     help="warm: trace variants (CSV of off,on)")
+    pc.add_argument("--bench-shapes", action="store_true",
+                    help="warm: prime the bench's exact timed-shape"
+                         " programs (bench.py shape tables) instead of"
+                         " the lint matrix — pre-warm a serving worker or"
+                         " CI without running a bench golden phase")
+    pc.add_argument("--smoke", action="store_true",
+                    help="warm --bench-shapes: use the bench's smoke"
+                         " shapes (tiny, CPU)")
     pc.add_argument("--program", default="",
                     help="purge: only entries whose program contains this")
     pc.add_argument("--protocol", default="",

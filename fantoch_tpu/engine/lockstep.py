@@ -1252,6 +1252,26 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             hist_overflow=st.hist_overflow
             + (lat_en & (lat_vals >= NB)).sum(),
         )
+        if _tr_has(st, "lat"):
+            # bucketed per-window latency channel ([W, G, LB]): recorded at
+            # the engine's one latency choke point, binned at the
+            # completion instant — per-window p50/p99 comes off-device for
+            # free (obs/report.lat_percentiles)
+            LB = TR.lat_buckets
+            oh_w = dense.oh(TR.window_of(now_rows), TR.max_windows)  # [C, W]
+            oh_lb = (
+                dense.oh(trace_mod.lat_bucket(lat_vals, LB), LB)
+                & lat_en[:, :, None]
+            )  # [C, NR, LB]
+            lat_contrib = jnp.einsum(
+                "cw,cg,cnb->wgb",
+                oh_w.astype(jnp.int32),
+                oh_g.astype(jnp.int32),
+                oh_lb.astype(jnp.int32),
+            )
+            st = st._replace(
+                trace={**st.trace, "lat": st.trace["lat"] + lat_contrib}
+            )
         subs = Candidates(
             valid=sub_valid,
             base=sub_base,

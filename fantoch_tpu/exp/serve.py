@@ -1,0 +1,241 @@
+"""Serve harness: build a serving runner + drive an external stream.
+
+The `fantoch_exp`-style front door of the streaming ingress
+(fantoch_tpu/ingress): construct the spec/env/runner for one serving
+deployment (protocol, n, device client slots, rifl windows, ring shapes),
+warm-start the serve program from the persistent AOT executable store, run
+a feed through `ServeRuntime`, and fold the device-side trace drain
+(per-window completion rates, bucketed-latency p50/p99 —
+obs/report.lat_percentiles) into one report dict. CLI:
+`python -m fantoch_tpu serve` (__main__.py); bench smoke face:
+`python bench.py --serve-smoke`.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from ..core.config import Config
+from ..core.planet import Planet
+from ..core.workload import KeyGen, Workload
+from ..engine import setup
+from ..obs.trace import TraceSpec
+from .harness import make_protocol_def
+
+# serving TraceSpec channel set: the live-telemetry subset plus the
+# bucketed latency histogram (percentiles off-device); the per-process
+# counter channels stay available via --trace-channels if wanted
+SERVE_CHANNELS = ("submit", "insert", "issued", "done", "lat")
+
+
+def build_serving(
+    protocol: str = "basic",
+    n: int = 3,
+    f: int = 1,
+    *,
+    clients_per_region: int = 2,
+    client_regions: Optional[Sequence[str]] = None,
+    process_regions: Optional[Sequence[str]] = None,
+    rifl_window: int = 64,
+    max_commands: int = 4096,
+    interval_ms: int = 100,
+    keys_per_command: int = 1,
+    key_space: int = 64,
+    batch: int = 1,
+    batch_delay_ms: int = 0,
+    ring_slots: int = 256,
+    mega_k: int = 4,
+    gc_interval_ms: int = 50,
+    pool_slots: Optional[int] = None,
+    max_steps: int = 1 << 30,
+    trace: Optional[TraceSpec] = None,
+    trace_window_ms: int = 100,
+    trace_windows: int = 256,
+    faults=None,
+    seed: int = 0,
+):
+    """(runner, mesh, spec, env, pdef, wl, tspec) for one serving config.
+
+    `rifl_window` is the per-client-slot sliding window (the device's
+    `commands_per_client` — how many rifls a slot can have in flight);
+    `max_commands` bounds the TOTAL merged submits of the serve (it sizes
+    the dot space: the runner is unwindowed, like the reference before
+    GC compaction). `batch` > 1 widens the protocol command to
+    `keys_per_command * batch` merged key slots and turns on the host
+    batcher (the runner spec itself stays batch_max_size=1 — its
+    contract)."""
+    from ..parallel import quantum
+
+    planet = Planet.new()
+    client_regions = list(client_regions or ["us-west1", "us-west2"])
+    pregions = list(process_regions or [r for r in planet.regions()][:n])
+    assert len(pregions) >= n, "not enough regions for n processes"
+    pregions = pregions[:n]
+    C = len(client_regions) * clients_per_region
+    wl = Workload(
+        shard_count=1,
+        key_gen=KeyGen.zipf(1.0, key_space),
+        keys_per_command=keys_per_command,
+        commands_per_client=rifl_window,
+        payload_size=100,
+    )
+    pdef = make_protocol_def(
+        protocol, n, setup.command_key_slots(wl, batch),
+        max_seq=max_commands, key_space_hint=wl.key_space(C),
+    )
+    leader = 1 if not pdef.leaderless else None
+    config = Config(n=n, f=f, gc_interval_ms=gc_interval_ms, leader=leader)
+    tspec = trace
+    if tspec is None:
+        tspec = TraceSpec(
+            window_ms=trace_window_ms, max_windows=trace_windows,
+            channels=SERVE_CHANNELS,
+        )
+    spec = setup.build_spec(
+        config, wl, pdef,
+        n_clients=C,
+        n_client_groups=len(client_regions),
+        max_seq=max_commands,
+        extra_ms=1000,
+        max_steps=max_steps,
+        open_loop_interval_ms=interval_ms,
+        batch_max_size=batch,
+        batch_max_delay_ms=batch_delay_ms,
+        pool_slots=pool_slots,
+        faults=faults is not None,
+        trace=tspec,
+    )
+    if batch > 1:
+        # the merged key width is already in spec.keys_per_command; the
+        # RUNNER contract is B=1 (host-side batching) — quantum.py raises
+        # on batched specs by design
+        spec = dataclasses.replace(spec, batch_max_size=1)
+    placement = setup.Placement(pregions, client_regions, clients_per_region)
+    env = setup.build_env(spec, config, planet, placement, wl, pdef,
+                          seed=seed, faults=faults)
+    runner = quantum.build_runner(
+        spec, pdef, wl, env,
+        ingress=quantum.IngressSpec(
+            ring_slots=ring_slots, mega_k=mega_k, batch_max_size=batch,
+        ),
+    )
+    mesh = quantum.make_mesh(spec.n)
+    return runner, mesh, spec, env, pdef, wl, tspec
+
+
+def drain_serve_trace(st, tspec: TraceSpec) -> Dict[str, Any]:
+    """Off-device drain of a finished serving state's trace tensors
+    (runner layout: per-device [n, W, ...] — aggregated over devices
+    here): per-window completion series + bucketed-latency percentiles."""
+    from ..obs import report as obs_report
+
+    out: Dict[str, Any] = {}
+    tr = getattr(st, "trace", None)
+    if tr is None:
+        return out
+    if "done" in tr:
+        done = np.asarray(tr["done"]).sum(axis=0)  # [W, G]
+        out["done_per_window"] = done.sum(axis=1).tolist()
+    if "lat" in tr:
+        lat = np.asarray(tr["lat"]).sum(axis=0)  # [W, G, LB]
+        out["latency"] = obs_report.lat_percentiles(lat, tspec.window_ms)
+    return out
+
+
+def run_serve(
+    protocol: str = "basic",
+    n: int = 3,
+    f: int = 1,
+    *,
+    # synthetic feed (ignored when `feed` is given)
+    logical_clients: int = 1000,
+    commands_per_client: int = 1,
+    interval_ms: int = 100,
+    read_only_pct: int = 0,
+    feed=None,
+    # deployment shapes
+    clients_per_region: int = 2,
+    client_regions: Optional[Sequence[str]] = None,
+    process_regions: Optional[Sequence[str]] = None,
+    rifl_window: int = 64,
+    keys_per_command: int = 1,
+    key_space: int = 64,
+    batch: int = 1,
+    batch_delay_ms: int = 0,
+    ring_slots: int = 256,
+    mega_k: int = 4,
+    window_ms: int = 100,
+    pool_slots: Optional[int] = None,
+    max_commands: Optional[int] = None,
+    trace_windows: int = 256,
+    # runtime policies
+    stall_gap_ms: int = 15000,
+    overflow: str = "defer",
+    max_queue: int = 100_000,
+    max_wall_s: Optional[float] = None,
+    max_megachunks: Optional[int] = None,
+    seed: int = 0,
+    faults=None,
+    cache=None,
+) -> Dict[str, Any]:
+    """One serve run end to end; returns the runtime report + trace drain
+    + cache counters. With no `feed`, replays a `SyntheticOpenLoopTrace`
+    over `logical_clients` open-loop clients."""
+    from ..ingress import ServeRuntime, SyntheticOpenLoopTrace
+
+    if feed is None:
+        feed = SyntheticOpenLoopTrace(
+            clients=logical_clients,
+            interval_ms=interval_ms,
+            commands_per_client=commands_per_client,
+            key_space=key_space,
+            keys_per_command=keys_per_command,
+            read_only_pct=read_only_pct,
+            seed=seed,
+        )
+        total = feed.total_commands
+    else:
+        total = max_commands or 0
+    if max_commands is None:
+        # merged submits <= logical commands; headroom for skewed routing
+        max_commands = max(1024, int(total) + 64)
+    runner, mesh, spec, env, pdef, wl, tspec = build_serving(
+        protocol, n, f,
+        clients_per_region=clients_per_region,
+        client_regions=client_regions,
+        process_regions=process_regions,
+        rifl_window=rifl_window,
+        max_commands=max_commands,
+        interval_ms=interval_ms,
+        keys_per_command=keys_per_command,
+        key_space=key_space,
+        batch=batch,
+        batch_delay_ms=batch_delay_ms,
+        ring_slots=ring_slots,
+        mega_k=mega_k,
+        pool_slots=pool_slots,
+        trace_window_ms=window_ms,
+        trace_windows=trace_windows,
+        faults=faults,
+        seed=seed,
+    )
+    rt = ServeRuntime(
+        runner, mesh, env,
+        window_ms=window_ms,
+        stall_gap_ms=stall_gap_ms,
+        overflow=overflow,
+        max_queue=max_queue,
+        cache=cache,
+    )
+    report, st = rt.run(feed, max_wall_s=max_wall_s,
+                        max_megachunks=max_megachunks)
+    report["protocol"] = protocol
+    report["n"] = n
+    report["devices"] = int(mesh.devices.size)
+    report["backend"] = str(mesh.devices.ravel()[0].platform)
+    report.update(drain_serve_trace(st, tspec))
+    if cache is not None:
+        report["cache"] = cache.stats()
+    return report

@@ -1,0 +1,20 @@
+"""Streaming ingress runtime: serve an open external command stream
+through the distributed quantum runner (the `fantoch/src/run` serving
+tier rebuilt host-side: feeds + batcher + submit rings + the serve loop).
+
+Device side: `parallel/quantum.py` (`IngressSpec`, `build_runner(...,
+ingress=...)`, `make_serve`). Host side here: stream sources
+(`stream.py`), the reference-semantics batcher (`batcher.py`), and the
+double-buffered serving loop (`runtime.py`). Harness entry:
+`exp/serve.py` + `python -m fantoch_tpu serve`.
+"""
+from ..parallel.quantum import IngressSpec, Pulse, Ring  # noqa: F401
+from .batcher import HostBatcher, MergedCmd  # noqa: F401
+from .runtime import ServeHealthError, ServeRuntime  # noqa: F401
+from .stream import (  # noqa: F401
+    SyntheticOpenLoopTrace,
+    TraceBatch,
+    file_feed,
+    record_workload_trace,
+    socket_feed,
+)

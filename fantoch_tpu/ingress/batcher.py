@@ -1,0 +1,149 @@
+"""Host-side client batcher/unbatcher for the serving path.
+
+The reference batches on the CLIENT side (`fantoch/src/run/task/client/
+batcher.rs:15-60`): up to `batch_max_size` consecutive commands of one
+client merge into a single protocol command (`Command::merge`,
+`command.rs:204-214`), flushing when the batch is full, `batch_max_delay_ms`
+old, or the stream ends; the unbatcher then fans the one reply back out to
+every constituent (`unbatcher.rs`). The event engine models exactly this
+in-engine (`engine/lockstep.py` `_client_rows`, `batch_max_size/delay`);
+the distributed runner deliberately does NOT (its contract is B=1 —
+`parallel/quantum.py` raises on batched specs), so the serving path batches
+HERE, before submit:
+
+- merged key slots: constituents' keys concatenated into
+  `keys_per_command * batch_max_size` slots, unused slots repeating the
+  last real key (leaves the conflict set identical to the reference's
+  merge — the lockstep rule);
+- one rifl per LOGICAL command (allocated at add), the merged command
+  carrying the first rifl + count; the device unbatches completions with
+  per-constituent issue instants (quantum.py ingress `b_client`), so
+  latency attribution matches the engine's batcher bit-for-bit;
+- `t_submit` is the flush instant (the trigger command's time), monotone
+  per client and never below the runtime's time floor — host deferral
+  shifts submission, never the recorded issue instants, so queueing
+  shows up in the measured latency instead of hiding.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple
+
+import numpy as np
+
+
+class MergedCmd(NamedTuple):
+    """One host-merged protocol command, ready for a submit ring row."""
+
+    gcid: int  # device client slot identity
+    rifl: int  # first constituent rifl (1-based)
+    cnt: int  # constituents merged (1..batch_max_size)
+    t_submit: int  # emission instant (flush trigger)
+    iss: np.ndarray  # [batch_max_size] int32 per-constituent issue instants
+    keys: np.ndarray  # [key_slots] int32 merged key slots
+    ro: bool  # all constituents read-only
+
+    @property
+    def last_rifl(self) -> int:
+        return self.rifl + self.cnt - 1
+
+
+class _Acc:
+    __slots__ = ("first_rifl", "first_t", "iss", "keys", "ro")
+
+    def __init__(self, rifl: int, t: int):
+        self.first_rifl = rifl
+        self.first_t = t
+        self.iss: List[int] = []
+        self.keys: List[int] = []
+        self.ro = True
+
+
+class HostBatcher:
+    """Per-client merge of the external stream into protocol commands."""
+
+    def __init__(self, batch_max_size: int, batch_max_delay_ms: int,
+                 key_slots: int):
+        if batch_max_size > 1:
+            assert batch_max_delay_ms >= 1, (
+                "batching needs batch_max_delay_ms >= 1 (the engine's rule:"
+                " a 0 delay degenerates every batch to one command)"
+            )
+        self.B = max(1, batch_max_size)
+        self.delay = batch_max_delay_ms
+        self.key_slots = key_slots
+        self._acc: Dict[int, _Acc] = {}
+        self._next_rifl: Dict[int, int] = {}
+        self._last_submit: Dict[int, int] = {}
+        self.merged_out = 0
+        self.logical_in = 0
+
+    def _emit(self, gcid: int, a: _Acc, t_submit: int) -> MergedCmd:
+        cnt = len(a.iss)
+        keys = np.asarray(a.keys, np.int32)
+        if len(keys) > self.key_slots:
+            # silently dropping a key would un-order conflicting commands
+            # (a consistency violation, not a capacity problem): the feed
+            # carries more keys per command than the spec was built for
+            raise ValueError(
+                f"merged command carries {len(keys)} keys but the spec"
+                f" has {self.key_slots} key slots (keys_per_command x"
+                " batch_max_size): rebuild the serving spec with the"
+                " feed's keys_per_command"
+            )
+        slots = np.full((self.key_slots,), keys[-1], np.int32)
+        slots[: len(keys)] = keys
+        iss = np.zeros((self.B,), np.int32)
+        iss[:cnt] = a.iss
+        # monotone submission per client (rifl order == arrival order)
+        t_submit = max(t_submit, self._last_submit.get(gcid, 0))
+        self._last_submit[gcid] = t_submit
+        self.merged_out += 1
+        return MergedCmd(gcid, a.first_rifl, cnt, int(t_submit), iss,
+                         slots, bool(a.ro))
+
+    def add(self, gcid: int, t: int, keys, read_only: bool,
+            t_floor: int = 0) -> List[MergedCmd]:
+        """One logical command into the batcher; returns flushed merges
+        (0 or 1). `t_floor` lower-bounds the SUBMIT instant (runtime time
+        floor); the recorded issue instant stays `t`."""
+        self.logical_in += 1
+        rifl = self._next_rifl.get(gcid, 1)
+        self._next_rifl[gcid] = rifl + 1
+        a = self._acc.get(gcid)
+        if a is None:
+            a = _Acc(rifl, t)
+            self._acc[gcid] = a
+        a.iss.append(int(t))
+        a.keys.extend(int(k) for k in np.asarray(keys).ravel())
+        a.ro = a.ro and bool(read_only)
+        # the engine's flush triggers, evaluated at the adding command's
+        # instant: full, or the batch is batch_max_delay_ms old
+        if len(a.iss) >= self.B or (t - a.first_t) >= self.delay:
+            del self._acc[gcid]
+            return [self._emit(gcid, a, max(int(t), t_floor))]
+        return []
+
+    def flush_due(self, now: int, t_floor: int = 0) -> List[MergedCmd]:
+        """Flush every batch that is `batch_max_delay_ms` old at `now` —
+        the delay-expiry flush a real batcher task performs between
+        arrivals (the in-engine model only flushes on ticks; a server
+        must not sit on a partial batch of an idle client)."""
+        out = []
+        for gcid in [g for g, a in self._acc.items()
+                     if (now - a.first_t) >= self.delay]:
+            a = self._acc.pop(gcid)
+            out.append(self._emit(gcid, a, max(a.first_t + self.delay,
+                                               t_floor)))
+        return out
+
+    def flush_all(self, now: int, t_floor: int = 0) -> List[MergedCmd]:
+        """End-of-stream flush (the engine's `last` trigger)."""
+        out = []
+        for gcid in list(self._acc):
+            a = self._acc.pop(gcid)
+            out.append(self._emit(gcid, a, max(int(now), t_floor)))
+        return out
+
+    @property
+    def pending(self) -> int:
+        return sum(len(a.iss) for a in self._acc.values())

@@ -1,0 +1,525 @@
+"""Host-side serving loop: an open command stream through the quantum runner.
+
+The reference's server runtime is a set of tokio tasks per process pulling
+from TCP sockets (`fantoch/src/run/mod.rs`); here the host is the ingress
+tier and the device mesh is the server fleet. Per megachunk (one device
+call, `IngressSpec.mega_k` ingress windows):
+
+1. **plan** — pull the feed through the host batcher (reference
+   batch_max_size/delay merge semantics, ingress/batcher.py), admit merged
+   commands into fixed-shape submit rings under per-client-slot
+   sliding-window backpressure (a rifl only issues once `rifl -
+   commands_per_client` is provably finished — the Pulse's `c_fin` flags),
+   and defer what does not fit (deferral shifts SUBMISSION, never the
+   recorded issue instant, so queueing shows up in the measured latency);
+2. **device_put** the rings while the previous megachunk is still in
+   flight (the double buffer: H2D of ring k overlaps compute of k-1);
+3. **account** the previous megachunk's `Pulse` — the ONE host sync per
+   megachunk: completions are drained from the done/issued counter diffs,
+   the liveness alarm is the bench stall watchdog's contract
+   (`obs/report.live_stall_gap_ms`: silence since the last completion
+   while the clock keeps advancing) in O(1) scalar form, and `c_fin`
+   advances the admission windows;
+4. **dispatch** the serve program (donated resident state, horizon-bounded
+   quantum loops, `parallel/quantum.py serve_local`).
+
+The steady state is exactly one dispatch + one small Pulse pull per
+megachunk — the same host-sync count as the closed-world megachunk driver
+(`syncs_per_megachunk` in the report records it; tests pin it).
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .batcher import HostBatcher, MergedCmd
+from .stream import TraceBatch
+
+_SEQ_BASE = 1 << 22  # injected tie-keys sort after protocol traffic
+
+
+class ServeHealthError(RuntimeError):
+    """A device-side capacity contract broke mid-serve (pool/inbox
+    overflow): results would be silently wrong, so the serve aborts."""
+
+
+class ServeRuntime:
+    """Drive one ingress-built quantum runner from an external feed.
+
+    `runner` comes from `quantum.build_runner(..., ingress=IngressSpec)`,
+    `mesh` from `quantum.make_mesh(n)`, `env` is the runner's Env (host
+    arrays for routing). `overflow` is the bounded-queue policy when the
+    stream outruns the device: "defer" (stop pulling; commands submit
+    later, their measured latency grows) or "drop" (count + discard).
+    """
+
+    def __init__(self, runner, mesh, env, *, window_ms: int = 100,
+                 stall_gap_ms: int = 15000, overflow: str = "defer",
+                 max_queue: int = 100_000, cache=None,
+                 client_map: str = "mod", drain_ms: Optional[int] = None):
+        assert overflow in ("defer", "drop"), overflow
+        assert runner.ingress is not None, (
+            "build the runner with ingress=IngressSpec(...)"
+        )
+        if runner.ingress.batch_max_size > runner.ct:
+            raise ValueError(
+                f"batch_max_size {runner.ingress.batch_max_size} exceeds"
+                f" the per-slot rifl window (commands_per_client ="
+                f" {runner.ct}): a merged command could never fit the"
+                " sliding admission window — raise rifl_window or lower"
+                " the batch"
+            )
+        self.runner = runner
+        self.spec = runner.spec
+        self.ingress = runner.ingress
+        self.mesh = mesh
+        self.cache = cache
+        self.serve = runner.make_serve(mesh, cache=cache)
+        self.window_ms = int(window_ms)
+        self.stall_gap_ms = int(stall_gap_ms)
+        self.overflow = overflow
+        self.max_queue = int(max_queue)
+        self.client_map = client_map
+        self.K = self.ingress.mega_k
+        self.R = self.ingress.ring_slots
+        self.NR = self.ingress.batch_max_size
+        self.CT = runner.ct
+        self.C_TOTAL = self.spec.n_clients
+        self.shards = self.spec.shards
+        # host routing tables
+        self.g2p = np.asarray(runner.lenv.g2p)
+        self.g2s = np.asarray(runner.lenv.g2s)
+        self.client_proc = np.asarray(env.client_proc)
+        self.dist_cp = np.asarray(env.dist_cp)
+        self.batcher = HostBatcher(
+            self.NR, getattr(self.spec, "batch_max_delay_ms", 0) or 0,
+            self.spec.keys_per_command,
+        )
+        # admission state
+        self._queues: Dict[int, deque] = {}
+        self._queued_logical = 0
+        self.fin: Dict[int, int] = {}  # highest contiguous finished rifl
+        self.adm: Dict[int, int] = {}  # highest admitted rifl
+        self._seq = _SEQ_BASE
+        # per-coordinator dot budget: the runner is unwindowed (no GC
+        # compaction of its dot tables yet — ROADMAP item 1 remainder),
+        # so each arrival device can allocate at most spec.max_seq dots;
+        # the host guards it precisely (the device would otherwise drop
+        # and abort with a generic capacity error)
+        self._dots_used: Dict[int, int] = {}
+        # accounting
+        self.admitted_logical = 0
+        self.completed_logical = 0
+        self.merged_admitted = 0
+        self.deferred = 0
+        self.dropped_feed = 0
+        self.late_pull = 0
+        self.megachunks = 0
+        self.host_syncs = 0
+        self.sim_now = 0
+        self.faulted = 0
+        self.lat_cnt_total = 0
+        self.lat_sum_total = 0
+        # report telemetry (bounded for indefinite serves): the last 8192
+        # completion windows; the live stall check is scalar, see below
+        self._bins: deque = deque(maxlen=8192)
+        self._bins_w0 = 0  # window index of bins[0]
+        self._telemetry: deque = deque(maxlen=256)
+        # liveness reference: the last instant the serve provably made
+        # progress (a completion landed) or had nothing outstanding — an
+        # idle feed span must not read as a stall once work resumes.
+        # This is the O(1) scalar restatement of the bench watchdog's
+        # live_stall_gap_ms contract (silence since the last completion
+        # while the clock keeps advancing), which an indefinite serve
+        # needs — the per-window series below is report telemetry only
+        # and stays bounded
+        self._last_progress_ms = 0
+        # feed time-origin rebase (set on the first pulled command when
+        # its issue instant is far from 0 — e.g. an epoch-ms socket
+        # feed): the sim clock always starts at 0, so without a rebase
+        # the serve would crawl through empty windows to reach t0
+        self._t_shift: Optional[int] = None
+        # post-completion drain window (the closed-world engines' extra_ms:
+        # GC/cleanup bookkeeping keeps running after the last completion,
+        # so a drained serve matches a finished closed-world run)
+        self.drain_ms = (
+            int(drain_ms) if drain_ms is not None
+            else int(getattr(self.spec, "extra_ms", 0))
+        )
+        self._drain_until: Optional[int] = None
+        # submission time floor: arrivals must land strictly after the
+        # last served horizon (the conservative contract); nothing has
+        # been served yet, so instant 0 is still open
+        self._floor = 0
+
+    # -- feed ---------------------------------------------------------------
+
+    def _gcid(self, client: int) -> int:
+        """Logical client id -> device client slot (connection
+        multiplexing: a million logical clients ride C_TOTAL slots, like
+        connections share a server's accept pool)."""
+        if self.client_map == "mod":
+            return int(client) % self.C_TOTAL
+        return int(client)
+
+    def _enqueue(self, merged) -> None:
+        for m in merged:
+            self._queues.setdefault(m.gcid, deque()).append(m)
+            self._queued_logical += m.cnt
+
+    def _pull_feed(self, upto: int, t_floor: int) -> None:
+        """Consume the feed through the batcher up to issue instant
+        `upto` (inclusive), honoring the bounded queue."""
+        while True:
+            if self._feed_done and self._buf is None:
+                # end of stream (possibly discovered by _peek_next_ms):
+                # the batcher's `last` flush, exactly once
+                if not self._eof_flushed:
+                    self._eof_flushed = True
+                    self._enqueue(self.batcher.flush_all(upto, t_floor))
+                return
+            if self._buf is None:
+                try:
+                    self._buf = next(self._feed)
+                    self._buf_i = 0
+                except StopIteration:
+                    self._feed_done = True
+                    continue
+            b: TraceBatch = self._buf
+            i = self._buf_i
+            nb = b.count
+            # consume the prefix with t <= upto
+            if self._t_shift is None and b.count:
+                # first command decides the feed's time origin: rebase
+                # whole windows so within-window phase is preserved and a
+                # near-zero origin (recorded traces) shifts by exactly 0
+                self._t_shift = (
+                    int(b.t_ms[0]) // self.window_ms
+                ) * self.window_ms
+            while i < nb and int(b.t_ms[i]) - self._t_shift <= upto:
+                if (self._queued_logical + self.batcher.pending
+                        >= self.max_queue):
+                    if self.overflow == "drop":
+                        self.dropped_feed += 1
+                        i += 1
+                        continue
+                    # defer: stop pulling; the feed resumes next window
+                    # (commands keep their issue instants — the shifted
+                    # SUBMIT instant makes the queueing delay visible)
+                    self._buf_i = i
+                    self.late_pull += 1
+                    self._flush_due(upto, t_floor)
+                    return
+                t = int(b.t_ms[i]) - self._t_shift
+                self._enqueue(self.batcher.add(
+                    self._gcid(int(b.client[i])), t, b.keys[i],
+                    bool(b.read_only[i]), t_floor,
+                ))
+                i += 1
+            if i >= nb:
+                self._buf = None
+            else:
+                self._buf_i = i
+                break
+        self._flush_due(upto, t_floor)
+
+    def _flush_due(self, now: int, t_floor: int) -> None:
+        self._enqueue(self.batcher.flush_due(now, t_floor))
+
+    def _peek_next_ms(self) -> Optional[int]:
+        """Shifted issue instant of the next unconsumed feed record
+        (loads the next batch if needed, consumes nothing); None at
+        end of feed."""
+        while not self._feed_done:
+            if self._buf is not None and self._buf_i < self._buf.count:
+                return int(self._buf.t_ms[self._buf_i]) - (
+                    self._t_shift or 0
+                )
+            try:
+                self._buf = next(self._feed)
+                self._buf_i = 0
+            except StopIteration:
+                self._feed_done = True
+                self._buf = None
+        return None
+
+    # -- planning -----------------------------------------------------------
+
+    def _admissible(self, m: MergedCmd) -> bool:
+        return (m.last_rifl - self.fin.get(m.gcid, 0)) <= self.CT
+
+    def _admit_row(self, rings, k: int, slot: int, m: MergedCmd,
+                   t_eff: int) -> None:
+        tshard = int(m.keys[0]) % self.shards
+        dst = int(self.client_proc[m.gcid, tshard])
+        used = self._dots_used.get(dst, 0) + 1
+        if used > self.spec.max_seq:
+            raise ServeHealthError(
+                f"coordinator {dst} exhausted its dot space"
+                f" ({self.spec.max_seq} submits): the serving runner is"
+                " unwindowed — size max_commands (spec.max_seq) to the"
+                " trace, or bound the run with max_megachunks"
+            )
+        self._dots_used[dst] = used
+        # new work cancels a pending post-completion drain window (the
+        # serve went idle and resumed — e.g. across a compressed gap)
+        self._drain_until = None
+        rings.valid[k, slot] = True
+        rings.dst[k, slot] = dst
+        rings.arr[k, slot] = t_eff + int(self.dist_cp[m.gcid, tshard])
+        rings.gcid[k, slot] = m.gcid
+        rings.rifl[k, slot] = m.rifl
+        rings.cnt[k, slot] = m.cnt
+        rings.ro[k, slot] = int(m.ro)
+        rings.keys[k, slot, :] = m.keys
+        rings.iss[k, slot, :] = m.iss
+        rings.seq[k, slot] = min(self._seq, (1 << 24) - 1)
+        self._seq += 1
+        self.adm[m.gcid] = m.last_rifl
+        self.admitted_logical += m.cnt
+        self.merged_admitted += 1
+
+    def _plan(self, t: int):
+        """Build one megachunk's rings + horizons starting at instant
+        `t` (exclusive). Conservative contract: every admitted row's
+        arrival is > the previous horizon, and every deferred command's
+        submission shifts past this megachunk — so the device never
+        receives an arrival at or before an instant it already served."""
+        rings = self.runner.empty_rings()
+        horizons = np.zeros((self.K,), np.int32)
+        for k in range(self.K):
+            w_end = t + self.window_ms
+            t_floor = self._floor
+            # mid-stream idle-gap compression: with nothing queued, in
+            # flight, or mid-batch, a feed whose next command is beyond
+            # this megachunk gets its remaining timestamps shifted
+            # earlier (whole windows) — the t0 rebase's rule applied at
+            # every idle gap, so an hour-long silence costs zero empty
+            # device dispatches instead of gap/window of them
+            if (not self._queues and self.batcher.pending == 0
+                    and self.admitted_logical == self.completed_logical
+                    and self._t_shift is not None):
+                nxt = self._peek_next_ms()
+                if nxt is not None and nxt > w_end:
+                    self._t_shift += (
+                        (nxt - t_floor) // self.window_ms
+                    ) * self.window_ms
+            self._pull_feed(w_end, t_floor)
+            slot = 0
+            progress = True
+            while slot < self.R and progress:
+                progress = False
+                for g in list(self._queues.keys()):
+                    if slot >= self.R:
+                        break
+                    q = self._queues.get(g)
+                    if not q:
+                        del self._queues[g]
+                        continue
+                    m = q[0]
+                    if max(m.t_submit, t_floor) > w_end:
+                        # beyond this window (inclusive: a command issued
+                        # exactly at w_end is served by this segment —
+                        # the floor of the next one is w_end + 1)
+                        continue
+                    if not self._admissible(m):
+                        continue
+                    q.popleft()
+                    self._queued_logical -= m.cnt
+                    self._admit_row(rings, k, slot, m,
+                                    max(m.t_submit, t_floor))
+                    slot += 1
+                    progress = True
+            # heads that wanted this window but could not enter (ring
+            # full or rifl-window backpressure): defer to the window end.
+            # `deferred` counts deferral EVENTS (a command blocked for M
+            # windows counts M times) — the report documents it as such
+            for g, q in self._queues.items():
+                if q and max(q[0].t_submit, t_floor) <= w_end:
+                    q[0] = q[0]._replace(t_submit=w_end + 1)
+                    self.deferred += 1
+            horizons[k] = w_end
+            t = w_end
+            self._floor = w_end + 1
+        return rings, horizons
+
+    # -- accounting ---------------------------------------------------------
+
+    def _account(self, pulse, snap: Dict[int, int]) -> None:
+        p = jax.device_get(pulse)  # the ONE host sync of this megachunk
+        self.host_syncs += 1
+        if int(np.asarray(p.inj_drop).sum()):
+            raise ServeHealthError(
+                f"inject refused {int(np.asarray(p.inj_drop).sum())} ring"
+                " rows (inbox full) — host admission control must prevent"
+                " this; raise inbox_slots or lower ring_slots/mega_k"
+            )
+        if int(np.asarray(p.dropped).sum()):
+            raise ServeHealthError(
+                f"device dropped {int(np.asarray(p.dropped).sum())}"
+                " messages (send/inbox capacity) — results would be wrong"
+            )
+        completed = int(np.asarray(p.c_resp).sum())
+        delta = completed - self.completed_logical
+        self.completed_logical = completed
+        self.sim_now = int(np.asarray(p.now))
+        self.faulted = int(np.asarray(p.faulted).sum())
+        self.lat_cnt_total = int(np.asarray(p.lat_cnt).sum())
+        self.lat_sum_total = int(np.asarray(p.lat_sum).sum())
+        w = max(0, self.sim_now // self.window_ms)
+        # bounded per-window report series: deque drops the oldest
+        # windows; self._bins_w0 tracks the window index of bins[0]
+        while self._bins_w0 + len(self._bins) <= w:
+            if len(self._bins) == self._bins.maxlen:
+                self._bins_w0 += 1
+            self._bins.append(0)
+        self._bins[w - self._bins_w0] += delta
+        if delta > 0 or self.admitted_logical <= self.completed_logical:
+            self._last_progress_ms = self.sim_now
+        self._telemetry.append({
+            "sim_ms": self.sim_now,
+            "issued": int(np.asarray(p.c_issued).sum()),
+            "completed": completed,
+            "steps": int(np.asarray(p.step).sum()),
+        })
+        cfin = np.asarray(p.c_fin)  # [n, CM, CT]
+        for g, adm_r in snap.items():
+            f = self.fin.get(g, 0)
+            pdev, s = int(self.g2p[g]), int(self.g2s[g])
+            while f < adm_r and cfin[pdev, s, f % self.CT]:
+                f += 1
+            self.fin[g] = f
+
+    def _stalled(self) -> Optional[float]:
+        if self.stall_gap_ms <= 0:
+            return None
+        if self.admitted_logical <= self.completed_logical:
+            return None
+        # the watchdog signal — live_stall_gap_ms's contract in O(1)
+        # scalar form (silence since the last completion while the clock
+        # kept advancing), with the progress reference so an idle feed
+        # span (nothing outstanding, clock advancing on timers) never
+        # reads as a stall once work resumes
+        gap = float(self.sim_now - self._last_progress_ms)
+        return gap if gap > self.stall_gap_ms else None
+
+    def _complete(self) -> bool:
+        return (
+            self._feed_done
+            and self.batcher.pending == 0
+            and not any(self._queues.values())
+            and self._queued_logical == 0
+            and self.admitted_logical == self.completed_logical
+        )
+
+    # -- main loop ----------------------------------------------------------
+
+    def run(self, feed, *, max_wall_s: Optional[float] = None,
+            max_megachunks: Optional[int] = None) -> Tuple[Dict[str, Any], Any]:
+        """Serve `feed` to completion (or stall/limit abort). Returns
+        `(report, final_state)`; the final state still carries the trace
+        tensors for off-device percentile drains."""
+        self._feed: Iterator[TraceBatch] = iter(feed)
+        self._feed_done = False
+        self._eof_flushed = False
+        self._buf = None
+        self._buf_i = 0
+        st = self.runner.init_state()
+        inflight = None
+        aborted: Optional[str] = None
+        stall_gap: Optional[float] = None
+        t = 0
+        t0 = time.perf_counter()
+        while True:
+            # snapshot the admission counters: a megachunk planned but
+            # never dispatched (an abort lands between plan and dispatch)
+            # must not inflate the report's issued/deferred numbers
+            pre_plan = (self.admitted_logical, self.merged_admitted,
+                        self.deferred, dict(self.adm),
+                        dict(self._dots_used))
+            rings, horizons = self._plan(t)
+            # H2D of the NEXT megachunk's rings overlaps the in-flight
+            # megachunk (async dispatch): the double-buffered submit path
+            rings_dev = jax.device_put(rings)
+            hz_dev = jnp.asarray(horizons, jnp.int32)
+            if inflight is not None:
+                self._account(*inflight)
+                inflight = None
+                stall_gap = self._stalled()
+                if stall_gap is not None:
+                    aborted = "stall"
+                    (self.admitted_logical, self.merged_admitted,
+                     self.deferred, self.adm, self._dots_used) = pre_plan
+                    break
+            if self._complete():
+                # post-completion drain: keep the horizons advancing for
+                # drain_ms more simulated time so GC/cleanup bookkeeping
+                # quiesces like a finished closed-world run (extra_ms)
+                if self._drain_until is None:
+                    self._drain_until = self.sim_now + self.drain_ms
+                if self.drain_ms <= 0 or self.sim_now >= self._drain_until:
+                    break
+            if (max_megachunks is not None
+                    and self.megachunks >= max_megachunks) or (
+                    max_wall_s is not None
+                    and time.perf_counter() - t0 > max_wall_s):
+                aborted = (
+                    "megachunk_limit"
+                    if max_megachunks is not None
+                    and self.megachunks >= max_megachunks
+                    else "wall_clock"
+                )
+                (self.admitted_logical, self.merged_admitted,
+                 self.deferred, self.adm, self._dots_used) = pre_plan
+                break
+            snap = dict(self.adm)
+            st, pulse = self.serve(st, rings_dev, hz_dev)
+            self.megachunks += 1
+            inflight = (pulse, snap)
+            t = int(horizons[-1])
+        if inflight is not None:
+            self._account(*inflight)
+        wall_s = time.perf_counter() - t0
+        n_dev = int(self.mesh.devices.size)
+        done = self.completed_logical
+        report: Dict[str, Any] = {
+            "commands_in": self.batcher.logical_in + self.dropped_feed,
+            "merged_submits": self.merged_admitted,
+            "issued": self.admitted_logical,
+            "completed": done,
+            # deferral EVENTS (one per blocked head per window, so a
+            # long-blocked command counts once per window it waited)
+            "deferred": self.deferred,
+            "dropped_feed": self.dropped_feed,
+            # times the bounded queue paused feed ingestion (defer policy)
+            "late_pull": self.late_pull,
+            "faulted": self.faulted,
+            "megachunks": self.megachunks,
+            "host_syncs": self.host_syncs,
+            "syncs_per_megachunk": round(
+                self.host_syncs / max(self.megachunks, 1), 3
+            ),
+            "windows_per_megachunk": self.K,
+            "sim_ms": self.sim_now,
+            "wall_s": round(wall_s, 3),
+            "commands_per_sec": round(done / max(wall_s, 1e-9), 1),
+            "commands_per_sec_per_chip": round(
+                done / max(wall_s, 1e-9) / max(n_dev, 1), 1
+            ),
+            "mean_latency_ms": round(
+                self.lat_sum_total / max(self.lat_cnt_total, 1), 2
+            ),
+            "stall_abort": aborted == "stall",
+            "stall_gap_ms": stall_gap,
+            "aborted": aborted,
+            "completions_per_window": list(self._bins),
+            "completions_window0": self._bins_w0,
+            "feed_t_shift_ms": self._t_shift or 0,
+            "telemetry": list(self._telemetry)[-64:],
+        }
+        return report, st

@@ -1,0 +1,225 @@
+"""External command streams: the serving path's input side.
+
+The reference's clients are tokio tasks opening TCP connections to the
+server (`fantoch/src/run/task/client/mod.rs`); here the equivalent surface
+is an *iterator of `TraceBatch`es* — vectorized, time-ordered command
+records — so one object type serves three sources:
+
+- `SyntheticOpenLoopTrace`: a replayable open-loop generator scaling to
+  millions of logical clients (clients are staggered across the interval
+  and generated cohort-at-a-time with numpy, never one Python object per
+  client). Same parameters => bit-identical stream, so a serve run is a
+  replay, not a sample.
+- `record_workload_trace`: the EXACT command stream a closed-world
+  open-loop engine run issues for a (spec, env, workload) — same sampler,
+  same seed-folding, same tick instants. Feeding it through the ingress
+  must reproduce the baked-in run's observables (pinned in
+  tests/test_ingress.py): the serving path inherits the existing
+  correctness oracles.
+- `file_feed` / `socket_feed`: line-JSON command records from a file or a
+  TCP connection (`{"t": ms, "client": id, "keys": [...], "ro": 0|1}`),
+  the external-world entry point.
+
+All sources yield batches with globally nondecreasing `t_ms`.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Iterator, NamedTuple, Optional
+
+import numpy as np
+
+
+class TraceBatch(NamedTuple):
+    """One time-ordered slab of external commands."""
+
+    t_ms: np.ndarray  # [B] int64 nondecreasing issue instants
+    client: np.ndarray  # [B] int64 logical client ids (any range)
+    keys: np.ndarray  # [B, kpc] int32
+    read_only: np.ndarray  # [B] bool
+
+    @property
+    def count(self) -> int:
+        return int(self.t_ms.shape[0])
+
+
+@dataclasses.dataclass(frozen=True)
+class SyntheticOpenLoopTrace:
+    """Replayable synthetic open-loop trace over `clients` logical clients.
+
+    Client c issues command i at `start_ms + (c % interval_ms) +
+    i * interval_ms`: the population is staggered uniformly across the
+    interval, so a million clients at a 100 ms interval is a steady
+    10k commands/ms, not a thundering herd. Keys are uniform over
+    `key_space` from a counter-based PRNG keyed by (seed, i, phase) —
+    the same parameters always replay the same stream.
+    """
+
+    clients: int
+    interval_ms: int
+    commands_per_client: int
+    key_space: int
+    keys_per_command: int = 1
+    read_only_pct: int = 0
+    seed: int = 0
+    start_ms: int = 0
+
+    @property
+    def total_commands(self) -> int:
+        return self.clients * self.commands_per_client
+
+    @property
+    def horizon_ms(self) -> int:
+        """Last issue instant of the trace."""
+        return (
+            self.start_ms
+            + (self.commands_per_client - 1) * self.interval_ms
+            + min(self.clients, self.interval_ms) - 1
+        )
+
+    def batches(self) -> Iterator[TraceBatch]:
+        iv = self.interval_ms
+        for i in range(self.commands_per_client):
+            for ph in range(min(iv, self.clients)):
+                cs = np.arange(ph, self.clients, iv, dtype=np.int64)
+                if cs.size == 0:
+                    continue
+                t = self.start_ms + ph + i * iv
+                rng = np.random.default_rng(
+                    np.random.SeedSequence([self.seed, i, ph])
+                )
+                keys = rng.integers(
+                    0, self.key_space,
+                    size=(cs.size, self.keys_per_command),
+                ).astype(np.int32)
+                if self.keys_per_command > 1:
+                    # distinct key slots (the workload sampler's rejection
+                    # rule, cheap form): bump duplicates by their slot
+                    for j in range(1, self.keys_per_command):
+                        dup = (keys[:, j:j + 1] == keys[:, :j]).any(axis=1)
+                        keys[dup, j] = (
+                            keys[dup, j] + j
+                        ) % self.key_space
+                ro = rng.integers(0, 100, size=cs.size) < self.read_only_pct
+                yield TraceBatch(
+                    np.full(cs.size, t, np.int64), cs, keys, ro
+                )
+
+    def __iter__(self) -> Iterator[TraceBatch]:
+        return self.batches()
+
+
+def record_workload_trace(spec, env, wl) -> Iterator[TraceBatch]:
+    """The exact command stream the closed-world OPEN-loop engines issue
+    for `(spec, env, wl)`: command i of client c at `i *
+    open_loop_interval_ms`, keys/read-only from the engine's own sampler
+    (`core/workload.sample_command_keys`) on the env's seed — the
+    deterministic-replay input of the ingress bit-identity tests."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core import workload as workload_mod
+
+    assert spec.open_loop_interval_ms is not None, (
+        "record_workload_trace replays OPEN-loop workloads (closed loops"
+        " issue on reply — there is no external schedule to replay)"
+    )
+    consts = workload_mod.WorkloadConsts.build(wl)
+    C, CPC = spec.n_clients, spec.commands_per_client
+    iv = spec.open_loop_interval_ms
+    keys, ro = jax.jit(
+        jax.vmap(
+            lambda c: jax.vmap(
+                lambda i: workload_mod.sample_command_keys(
+                    consts,
+                    jax.random.wrap_key_data(jnp.asarray(env.seed)),
+                    c, i,
+                    jnp.asarray(env.conflict_rate),
+                    jnp.asarray(env.read_only_pct),
+                )
+            )(jnp.arange(CPC, dtype=jnp.int32))
+        )
+    )(jnp.arange(C, dtype=jnp.int32))
+    keys = np.asarray(keys)  # [C, CPC, kpc]
+    ro = np.asarray(ro)
+    for i in range(CPC):
+        yield TraceBatch(
+            np.full(C, i * iv, np.int64),
+            np.arange(C, dtype=np.int64),
+            keys[:, i, :].astype(np.int32),
+            ro[:, i],
+        )
+
+
+# ---------------------------------------------------------------------------
+# external feeds (file / socket)
+# ---------------------------------------------------------------------------
+
+
+def _lines_to_batches(lines, batch: int) -> Iterator[TraceBatch]:
+    buf = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        rec = json.loads(line)
+        buf.append(rec)
+        if len(buf) >= batch:
+            yield _batch_of(buf)
+            buf = []
+    if buf:
+        yield _batch_of(buf)
+
+
+def _batch_of(recs) -> TraceBatch:
+    kpc = max(len(r.get("keys", [0])) for r in recs)
+    keys = np.zeros((len(recs), kpc), np.int32)
+    for i, r in enumerate(recs):
+        ks = r.get("keys", [0]) or [0]
+        keys[i, : len(ks)] = ks
+        keys[i, len(ks):] = ks[-1]
+    return TraceBatch(
+        np.asarray([int(r["t"]) for r in recs], np.int64),
+        np.asarray([int(r.get("client", 0)) for r in recs], np.int64),
+        keys,
+        np.asarray([bool(r.get("ro", 0)) for r in recs]),
+    )
+
+
+def file_feed(path_or_fp, batch: int = 1024) -> Iterator[TraceBatch]:
+    """Line-JSON command feed from a path or an open text file:
+    one `{"t": ms, "client": id, "keys": [...], "ro": 0|1}` per line,
+    nondecreasing `t`."""
+    if hasattr(path_or_fp, "read"):
+        yield from _lines_to_batches(path_or_fp, batch)
+        return
+    with open(path_or_fp) as f:
+        yield from _lines_to_batches(f, batch)
+
+
+def socket_feed(host: str = "127.0.0.1", port: int = 0, *,
+                batch: int = 1024, listener=None,
+                timeout_s: Optional[float] = 30.0) -> Iterator[TraceBatch]:
+    """Accept ONE TCP connection and stream its line-JSON commands (the
+    same record format as `file_feed`) — the socket face of the ingress.
+    Pass an already-bound `listener` socket to control the port (e.g.
+    `socket.create_server(("127.0.0.1", 0))`); otherwise one is created.
+    The generator owns and closes the sockets."""
+    import socket
+
+    own = listener is None
+    if own:
+        listener = socket.create_server((host, port))
+    try:
+        listener.settimeout(timeout_s)
+        conn, _addr = listener.accept()
+        try:
+            conn.settimeout(timeout_s)
+            with conn.makefile("r") as f:
+                yield from _lines_to_batches(f, batch)
+        finally:
+            conn.close()
+    finally:
+        if own:
+            listener.close()

@@ -19,7 +19,7 @@ from typing import Any, Dict, Optional, Sequence
 
 import numpy as np
 
-from .trace import PER_GROUP, TraceSpec
+from .trace import PER_GROUP, TraceSpec, lat_bucket_upper_ms
 
 _SPARK = "▁▂▃▄▅▆▇█"
 
@@ -72,6 +72,40 @@ def live_stall_gap_ms(per_window: Sequence[float], now_ms: int,
             return 0.0
         return float(now - (last + 1) * window_ms)
     return float((cur_w - last) * window_ms)
+
+
+def bucket_percentile(hist: Sequence[float], q: float) -> Optional[float]:
+    """Percentile (ms, inclusive upper bucket edge) of one bucketed
+    latency histogram row ([LB] counts from the "lat" channel,
+    obs/trace.py power-of-two buckets). None on an empty histogram.
+    Conservative: the true percentile is <= the returned edge."""
+    h = np.asarray(hist, dtype=np.int64)
+    total = int(h.sum())
+    if total == 0:
+        return None
+    c = np.cumsum(h)
+    b = int(np.searchsorted(c, max(1, int(np.ceil(q * total)))))
+    return float(lat_bucket_upper_ms(min(b, len(h) - 1)))
+
+
+def lat_percentiles(arr_wgb: np.ndarray, window_ms: int) -> Dict[str, Any]:
+    """Derived percentile views of a drained "lat" channel slice
+    ([U, G, LB]): overall p50/p99 plus per-window p50/p99 timelines — the
+    cdf-over-time family (ROADMAP item 5's rider; `plot.plots.
+    latency_cdf_over_time` renders it)."""
+    arr = np.asarray(arr_wgb)
+    per_w = arr.sum(axis=1)  # [U, LB]
+    overall = per_w.sum(axis=0)  # [LB]
+    return {
+        "window_ms": window_ms,
+        "overall": {
+            "count": int(overall.sum()),
+            "p50_ms": bucket_percentile(overall, 0.50),
+            "p99_ms": bucket_percentile(overall, 0.99),
+        },
+        "p50_per_window": [bucket_percentile(h, 0.50) for h in per_w],
+        "p99_per_window": [bucket_percentile(h, 0.99) for h in per_w],
+    }
 
 
 def diff_reports(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
@@ -180,6 +214,8 @@ def drain(
                 for g, region in enumerate(client_regions)
                 if g < arr.shape[1]
             }
+        if name == "lat" and arr.ndim == 3:
+            rec["percentiles"] = lat_percentiles(arr[:used], wm)
         channels[name] = rec
 
     report: Dict[str, Any] = {

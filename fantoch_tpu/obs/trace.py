@@ -61,10 +61,20 @@ PER_PROC_COUNTERS = ("commit", "fast", "slow", "execute")
 PER_PROC_EVENTS = ("submit", "deliver", "crashed")
 PER_GROUP = ("issued", "done")
 GLOBAL = ("insert", "pool_hw")
-CHANNELS: Tuple[str, ...] = (
+# bucketed latency histogram: [W, G, LB] — per window-of-completion, per
+# client group, per power-of-two latency bucket (lat in [2^b - 1,
+# 2^(b+1) - 1) lands in bucket b). Recorded at the engines' latency choke
+# points (lockstep `_client_rows`, the runner's `b_client`), so per-window
+# p50/p99 percentile timelines come off-device for free (obs/report.py
+# derives them at drain). OPT-IN: not in DEFAULT_CHANNELS — enabling it is
+# a different compiled program, and the default trace programs (budgets,
+# cross-engine equality pins) must stay bit-identical.
+PER_GROUP_BUCKETS = ("lat",)
+DEFAULT_CHANNELS: Tuple[str, ...] = (
     "submit", "deliver", "insert", "commit", "fast", "slow", "execute",
     "issued", "done", "pool_hw", "crashed",
 )
+CHANNELS: Tuple[str, ...] = DEFAULT_CHANNELS + PER_GROUP_BUCKETS
 
 # protocol/executor state leaves backing the diffed counter channels
 COUNTER_LEAVES = {
@@ -82,7 +92,10 @@ class TraceSpec:
 
     window_ms: int = 100
     max_windows: int = 64
-    channels: Tuple[str, ...] = CHANNELS
+    channels: Tuple[str, ...] = DEFAULT_CHANNELS
+    # bucket count of the opt-in "lat" channel (power-of-two edges: bucket
+    # b covers [2^b - 1, 2^(b+1) - 1) ms, so 16 buckets span ~32 s)
+    lat_buckets: int = 16
 
     def __post_init__(self):
         assert self.window_ms >= 1, "window_ms must be >= 1"
@@ -125,6 +138,8 @@ def init_trace(
             continue
         if name in PER_GROUP:
             shape = (W, G)
+        elif name in PER_GROUP_BUCKETS:
+            shape = (W, G, tspec.lat_buckets)
         elif name in GLOBAL:
             shape = (W,)
         else:
@@ -173,6 +188,25 @@ def wadd_groups(arr: jnp.ndarray, w: jnp.ndarray, g: jnp.ndarray,
         ohg.astype(jnp.int32),
         delta.astype(jnp.int32),
     )
+
+
+def lat_bucket(lat, nb: int) -> jnp.ndarray:
+    """Power-of-two latency bucket of `lat` (ms): bucket b covers
+    [2^b - 1, 2^(b+1) - 1), the last bucket absorbs the tail. Exact
+    integer comparisons (no float log), so bucket boundaries are
+    bit-stable across backends."""
+    lat = jnp.asarray(lat, jnp.int32)
+    edges = jnp.int32(1) << jnp.arange(1, nb, dtype=jnp.int32)  # [nb-1]
+    return jnp.sum(
+        (lat[..., None] + 1) >= edges, axis=-1
+    ).astype(jnp.int32)
+
+
+def lat_bucket_upper_ms(b: int) -> int:
+    """Inclusive upper edge (ms) of latency bucket `b` — the value a
+    percentile read off the bucketed channel reports (conservative: the
+    true percentile is <= it)."""
+    return (1 << (b + 1)) - 2
 
 
 def crashed_windows(tspec: TraceSpec, crash_at, recover_at) -> jnp.ndarray:

@@ -52,6 +52,7 @@ whose drain window is quiet at the boundary.
 """
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Any, NamedTuple
 
@@ -89,6 +90,73 @@ RK_TICK = 4
 RK_PROTO_BASE = 5
 
 AXIS = "procs"
+
+
+@dataclasses.dataclass(frozen=True)
+class IngressSpec:
+    """Static shape parameters of the runner's streaming-ingress mode
+    (part of the compile identity — hashable, changing any field is a
+    different serve program).
+
+    The serving contract: the runner keeps its closed-world B=1 message
+    semantics; commands enter at RUNTIME through fixed-shape submit rings
+    (`Ring`) the host `jax.device_put`s and the jitted serve program
+    merges into the per-device inboxes — `mega_k` ring segments (ingress
+    windows) per device call, each followed by a horizon-bounded quantum
+    loop, so the steady state stays at ONE host sync (the `Pulse` pull)
+    per megachunk. Client-side batching is HOST-side work
+    (fantoch_tpu/ingress/batcher.py): a merged command arrives with
+    `batch_max_size`-worth of key slots and per-constituent issue times,
+    and the owner device unbatches completions with the lockstep
+    engine's attribution rules (one latency record per constituent)."""
+
+    ring_slots: int = 256  # R: merged commands per ring segment
+    mega_k: int = 4  # K: ring segments (ingress windows) per device call
+    batch_max_size: int = 1  # NR: logical commands per merged command
+
+
+class Ring(NamedTuple):
+    """One megachunk's submit rings (host-built, replicated device input).
+
+    All leaves carry a leading [K, R] (= mega_k x ring_slots) shape;
+    invalid rows have valid=False. `dst` is the arrival device (the
+    client's connected process in the command's target shard), `arr` the
+    arrival instant (issue time + client->process delay), `iss` the
+    per-constituent ISSUE instants (c_sub_time stamps — latency is
+    measured from issue, so host-side deferral shows up in the recorded
+    latency, exactly as queueing should), `seq` a host-assigned monotone
+    tie-break (unique per run)."""
+
+    valid: jnp.ndarray  # [K, R] bool
+    dst: jnp.ndarray  # [K, R] int32 arrival device
+    arr: jnp.ndarray  # [K, R] int32 arrival instant
+    gcid: jnp.ndarray  # [K, R] int32 device client slot identity
+    rifl: jnp.ndarray  # [K, R] int32 first constituent rifl (1-based)
+    cnt: jnp.ndarray  # [K, R] int32 constituents merged (1..NR)
+    ro: jnp.ndarray  # [K, R] int32 0/1 all-read-only
+    keys: jnp.ndarray  # [K, R, KPC] int32 merged key slots
+    iss: jnp.ndarray  # [K, R, NR] int32 per-constituent issue instants
+    seq: jnp.ndarray  # [K, R] int32 tie-break sequence
+
+
+class Pulse(NamedTuple):
+    """The per-megachunk host pull of the serve program: the done/issued
+    counter values (the host diffs them — completions are drained from
+    counter diffs, never from a full state pull) plus the health
+    counters. Every leaf is per-device ([1, ...] locally, [n, ...]
+    gathered) except the replicated `now`."""
+
+    c_issued: jnp.ndarray  # [n, CM]
+    c_resp: jnp.ndarray  # [n, CM]
+    c_fin: jnp.ndarray  # [n, CM, CT] int32 0/1 per-rifl-slot completion
+    lat_cnt: jnp.ndarray  # [n, CM]
+    lat_sum: jnp.ndarray  # [n, CM]
+    step: jnp.ndarray  # [n]
+    now: jnp.ndarray  # replicated scalar
+    dropped: jnp.ndarray  # [n]
+    faulted: jnp.ndarray  # [n]
+    inj_drop: jnp.ndarray  # [n] ring rows refused by a full inbox
+    next_seq: jnp.ndarray  # [n]
 
 
 def _shard_map(fn, *, mesh, in_specs, out_specs):
@@ -185,6 +253,13 @@ class RState(NamedTuple):
     # boundary, crashed exactly from the static schedule at init.
     # Disabled = zero extra leaves, the identical program.
     trace: Any = None
+    # streaming-ingress leaves (None = closed world = empty pytree nodes,
+    # the identical program; see IngressSpec):
+    c_bcount: Any = None  # [n, CM, CT] merged-batch size by first rifl
+    c_fin: Any = None  # [n, CM, CT] 0/1 completion flag per rifl slot
+    # (cleared at inject, set at completion — the host's sliding-window
+    # admission reads it off the Pulse)
+    inj_drop: Any = None  # [n] ring rows refused by a full inbox
 
 
 class Local(NamedTuple):
@@ -201,17 +276,32 @@ class Local(NamedTuple):
 
 
 def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
-                 *, inbox_slots=None, send_slots=None):
+                 *, inbox_slots=None, send_slots=None, ingress=None):
     """(init_state, run_sharded) for a distributed run of one config.
 
     `env` is the standard single-config Env from engine/setup.py;
     `run_sharded(mesh, state)` requires mesh size == n.
+
+    `ingress` (an `IngressSpec`) builds the STREAMING variant instead:
+    no clients are baked into the program — commands enter at runtime
+    through submit rings and the runner exposes `make_serve(mesh)`
+    (see `serve_local`). The closed-world program is bit-identical when
+    `ingress` is None (every hook is Python-gated, extra leaves are empty
+    pytree nodes).
     """
     assert not spec.reorder, "message reordering is an event-engine mode"
-    assert spec.batch_max_size <= 1, (
-        "the distributed runner does not batch (client-side batching is an"
-        " event-engine mode)"
-    )
+    if spec.batch_max_size > 1:
+        raise ValueError(
+            "the distributed runner's contract is batch_max_size == 1:"
+            " client-side batching is host-side work in the serving path —"
+            " the ingress runtime (fantoch_tpu/ingress) merges commands"
+            " BEFORE submit (IngressSpec.batch_max_size + the host batcher,"
+            " which already widens keys_per_command to the merged slot"
+            " count), so the runner only ever sees B=1 protocol commands."
+            " Build the runner spec with batch_max_size=1; the event"
+            " engine (engine/lockstep.py) keeps the in-engine batching"
+            " mode."
+        )
     if spec.faults:
         # crash + partition schedules are deterministic functions of TIME,
         # so lockstep and the runner stay observation-equal under them; the
@@ -224,6 +314,18 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             " ids differ across engines); the runner supports crash and"
             " partition schedules"
         )
+    ING = ingress is not None
+    if ING and spec.open_loop_interval_ms is None:
+        raise ValueError(
+            "the streaming ingress serves open-loop semantics (commands"
+            " arrive on the server's clock, completions are counted apart"
+            " from issuance): build the spec with open_loop_interval_ms"
+            " set (it only gates the open-loop client layout here — the"
+            " actual issue instants come from the stream)"
+        )
+    NR_ING = ingress.batch_max_size if ING else 1
+    R_ING = ingress.ring_slots if ING else 0
+    K_ING = ingress.mega_k if ING else 0
     OPEN = spec.open_loop_interval_ms is not None
     CT = spec.commands_per_client if OPEN else 1
     n, C_TOTAL, S = spec.n, spec.n_clients, spec.pool_slots
@@ -237,7 +339,13 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
     exdef = pdef.executor
     consts = workload_mod.WorkloadConsts.build(wl)
     TR = spec.trace  # TraceSpec or None (obs/trace.py)
+    HAS_LAT = TR is not None and "lat" in TR.channels
     IP = inbox_slots or max(256, 2 * S // max(n, 1))
+    if ING:
+        # a full megachunk's worth of injected rows must fit beside the
+        # in-flight protocol traffic (inject refuses past capacity and
+        # counts inj_drop, which the serve runtime treats as fatal)
+        IP = max(IP, 2 * R_ING * K_ING)
     # worst-case send rows appended per handled event to one dst column
     WC = pdef.max_out + 2 + spec.max_res
     SB = send_slots or max(8 * WC, 64)
@@ -351,6 +459,11 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         faulted0 = np.zeros((n,), np.int32)
         fill = [0] * n
         for c in range(C_TOTAL):
+            if OPEN and ING:
+                # streaming ingress: NOTHING is seeded — commands enter at
+                # runtime through the submit rings (`_inject`); the client
+                # slots exist only as latency/aggregation bookkeeping
+                continue
             if OPEN:
                 # open loop: the first interval tick fires at the owner at
                 # t=0 (lockstep.py init_state OPEN path)
@@ -397,6 +510,10 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             for nm in ("issued", "done"):
                 if nm in ch:
                     trace0[nm] = jnp.zeros((n, W_TR, G), jnp.int32)
+            if "lat" in ch:
+                trace0["lat"] = jnp.zeros(
+                    (n, W_TR, G, TR.lat_buckets), jnp.int32
+                )
             if "issued" in trace0 and not OPEN:
                 # closed-loop clients issue command 1 inside init_state:
                 # seed window 0 (the lockstep engine's convention)
@@ -459,6 +576,9 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             proto=proto0,
             exec=exdef.init(spec, env),
             trace=trace0,
+            c_bcount=jnp.zeros((n, CM, CT), jnp.int32) if ING else None,
+            c_fin=jnp.zeros((n, CM, CT), jnp.int32) if ING else None,
+            inj_drop=jnp.zeros((n,), jnp.int32) if ING else None,
         )
 
     # ------------- device-side helpers (local leading axis = 1) -------------
@@ -535,6 +655,27 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         for j, v in enumerate(vals):
             out = out.at[j].set(jnp.asarray(v, jnp.int32))
         return out
+
+    def _rslot(rifl):
+        """rifl -> c_sub_time/c_got slot. The closed world allocates one
+        slot per command (rifl <= CT by construction); the streaming
+        ingress reuses slots modularly under the host's sliding-window
+        admission (a rifl only issues once rifl - CT's slot is free —
+        the Pulse's c_fin flags drive that)."""
+        if ING:
+            return (rifl - 1) % CT
+        return jnp.clip(rifl - 1, 0, CT - 1)
+
+    def _lat_note(st, g, lat, en):
+        """One bucketed-latency channel record at the completion instant
+        ([n, W, G, LB] tensor — the lockstep engine's [W, G, LB] channel
+        restated per device; obs/trace.py lat_bucket)."""
+        ts = dict(st.trace)
+        ts["lat"] = ts["lat"].at[
+            0, TR.window_of(st.now), g,
+            obs_trace.lat_bucket(lat, TR.lat_buckets),
+        ].add(jnp.asarray(en, jnp.int32))
+        return st._replace(trace=ts)
 
     def send_push(L: Local, dst, time, kind, payload, enable) -> Local:
         """Append one row to the `dst` send column (traced dst)."""
@@ -753,6 +894,48 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         def b_client(L):
             st = L.st
             cslot = jnp.clip(payload[0], 0, CM - 1)
+            if OPEN and ING:
+                # merged-command completion (streaming ingress): one
+                # latency record per constituent — the lockstep batcher's
+                # unbatch attribution (each constituent's own issue
+                # instant, stamped at inject), plus the c_fin flags the
+                # host's sliding-window admission reads off the Pulse
+                g = lenv.cl_group[myrow, cslot]
+                first = payload[1]
+                rs0 = _rslot(first)
+                cnt = (
+                    jnp.clip(st.c_bcount[0, cslot, rs0], 1, NR_ING)
+                    if NR_ING > 1
+                    else jnp.int32(1)
+                )
+                for b_i in range(NR_ING):
+                    rs_b = _rslot(first + b_i)
+                    en = jnp.int32(b_i) < cnt
+                    lat_b = st.now - st.c_sub_time[0, cslot, rs_b]
+                    st = st._replace(
+                        hist=st.hist.at[0, g, jnp.clip(lat_b, 0, NB - 1)]
+                        .add(en.astype(jnp.int32)),
+                        hist_overflow=st.hist_overflow.at[0].add(
+                            (en & (lat_b >= NB)).astype(jnp.int32)
+                        ),
+                        lat_sum=st.lat_sum.at[0, cslot].add(
+                            jnp.where(en, lat_b, 0)
+                        ),
+                        lat_cnt=st.lat_cnt.at[0, cslot].add(
+                            en.astype(jnp.int32)
+                        ),
+                        c_fin=st.c_fin.at[0, cslot, rs_b].set(
+                            jnp.where(en, 1, st.c_fin[0, cslot, rs_b])
+                        ),
+                    )
+                    if HAS_LAT:
+                        st = _lat_note(st, g, lat_b, en)
+                # the stream is unbounded: c_done/all_done never fire —
+                # the host serve runtime owns termination
+                st = st._replace(
+                    c_resp=st.c_resp.at[0, cslot].add(cnt)
+                )
+                return L._replace(st=st)
             # latency recording (_record_latency, lockstep.py:401): open
             # loop keys the submit time by the completed rifl, closed loop
             # by the single outstanding command
@@ -770,6 +953,8 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
                 lat_sum=st.lat_sum.at[0, cslot].add(lat),
                 lat_cnt=st.lat_cnt.at[0, cslot].add(1),
             )
+            if HAS_LAT:
+                st = _lat_note(st, g, lat, jnp.bool_(True))
             if OPEN:
                 # completion counted separately from issuance
                 # (lockstep.py _client_branch OPEN path)
@@ -844,7 +1029,7 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             kslot = jnp.clip(payload[3], 0, KPC - 1)
             value = payload[4]
             cslot = jnp.clip(lenv.g2s[g], 0, CM - 1)
-            rslot = jnp.clip(rifl - 1, 0, CT - 1)
+            rslot = _rslot(rifl)
             got = st.c_got[0, cslot, rslot] + 1
             L = L._replace(
                 st=st._replace(
@@ -863,6 +1048,12 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             """Open-loop interval tick at the client's owner: issue the
             next command toward its target shard's connected process and
             schedule the following tick (lockstep.py _tick_branch, B=1)."""
+            if ING:
+                # streaming ingress: no ticks are ever seeded or injected
+                # (commands arrive through the rings), and the dead branch
+                # must not trace — the merged key width (KPC = base keys x
+                # batch) exceeds what the workload sampler produces
+                return L
             st = L.st
             cslot = jnp.clip(payload[0], 0, CM - 1)
             i = st.c_issued[0, cslot]
@@ -1078,7 +1269,7 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
                 L = branch_cleanup(L, sel)
         return L
 
-    def quantum(L: Local, myrow) -> Local:
+    def quantum(L: Local, myrow, horizon=None) -> Local:
         st = L.st
         if spec.faults:
             # freeze crashed processes' periodic slots (shared rule with
@@ -1140,14 +1331,21 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             # bound deliberately-stalled fault schedules by sim time (the
             # engine's cond applies the same deadline)
             cont = cont & (t_next <= spec.deadline_ms)
+        if horizon is not None:
+            # serving horizon (traced scalar, no recompile per window):
+            # never process an instant the ingress has not yet injected
+            # all arrivals for — the conservative co-simulation bound;
+            # unlike final_time this is not a terminal state, the next
+            # serve segment picks up where this one paused
+            cont = cont & (t_next <= horizon)
         return L._replace(st=st, cont=cont)
 
-    def quantum_step(L: Local, myrow) -> Local:
+    def quantum_step(L: Local, myrow, horizon=None) -> Local:
         """One quantum, plus (when tracing) counter-diff recording binned
         at the quantum's instant — the lockstep engine's per-trip
         discipline restated per device (each device is one row)."""
         if TR is None:
-            return quantum(L, myrow)
+            return quantum(L, myrow, horizon)
         st = L.st
         pre_commit = getattr(st.proto, "commit_count", None)
         pre = {
@@ -1157,7 +1355,7 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             "issued": st.c_issued[0],
             "done": st.lat_cnt[0],
         }
-        L2 = quantum(L, myrow)
+        L2 = quantum(L, myrow, horizon)
         st2 = L2.st
         ts = dict(st2.trace)
         w = TR.window_of(st2.now)  # the instant this quantum processed
@@ -1227,17 +1425,240 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         )
         return fn(state)
 
+    # ------------------- streaming ingress (serving mode) -------------------
+
+    # compiled serve programs, shared per mesh across ServeRuntime
+    # instances of THIS runner (a second runtime on the same runner/mesh
+    # reuses the jit instead of retracing the whole quantum program)
+    _serve_fns: dict = {}
+
+    def _inject(st: RState, ring: Ring, myrow) -> RState:
+        """Merge one ring segment ([R] rows, replicated) into this device's
+        state: rows whose `dst` is this device land in the inbox as
+        RK_SUBMIT messages at their arrival instants (free-slot rank
+        assignment, the exchange's discipline); rows whose OWNER (shard-0
+        connected process) is this device stamp the client bookkeeping —
+        per-constituent c_sub_time, the batch count, cleared c_fin/c_got,
+        c_issued, and the issued/insert trace windows. Rows refused by a
+        full inbox count `inj_drop` (the serve runtime treats any nonzero
+        as fatal: host admission control must prevent it)."""
+        gc = jnp.clip(ring.gcid, 0, C_TOTAL - 1)
+        # --- arrival side: inbox merge ---
+        mine = ring.valid & (ring.dst == myrow)
+        if spec.faults:
+            # the engine's crash-arrival loss rule at the ingress boundary
+            # (engine/faults.py contract): a submit arriving inside this
+            # process's crash window is lost
+            lost = (
+                mine
+                & (ring.arr >= dense.dget(F_CRASH, myrow))
+                & (ring.arr < dense.dget(F_REC, myrow))
+            )
+            st = st._replace(faulted=st.faulted.at[0].add(lost.sum()))
+            mine = mine & ~lost
+        free = ~st.i_valid[0]
+        frank = jnp.cumsum(free) - 1
+        n_free = free.sum()
+        slot_for_rank = (
+            jnp.zeros((IP,), jnp.int32)
+            .at[jnp.where(free, frank, IP)]
+            .set(jnp.arange(IP, dtype=jnp.int32), mode="drop")
+        )
+        crank = jnp.cumsum(mine) - 1
+        ok = mine & (crank < n_free)
+        tgt = jnp.where(ok, slot_for_rank[jnp.clip(crank, 0, IP - 1)], IP)
+        pay = jnp.zeros((R_ING, W), jnp.int32)
+        pay = pay.at[:, 0].set(ring.gcid).at[:, 1].set(ring.rifl)
+        pay = pay.at[:, 2].set(ring.ro)
+        pay = pay.at[:, 3:3 + KPC].set(ring.keys)
+        st = st._replace(
+            i_valid=st.i_valid.at[0, tgt].set(True, mode="drop"),
+            i_time=st.i_time.at[0, tgt].set(ring.arr, mode="drop"),
+            i_src=st.i_src.at[0, tgt].set(
+                jnp.clip(lenv.g2p[gc], 0, n - 1), mode="drop"
+            ),
+            i_seq=st.i_seq.at[0, tgt].set(ring.seq, mode="drop"),
+            i_kind=st.i_kind.at[0, tgt].set(
+                jnp.full((R_ING,), RK_SUBMIT, jnp.int32), mode="drop"
+            ),
+            i_payload=st.i_payload.at[0, tgt].set(pay, mode="drop"),
+            inj_drop=st.inj_drop.at[0].add((mine & ~ok).sum()),
+        )
+        tr = st.trace
+        if TR is not None and tr is not None and "insert" in tr:
+            # injected rows never cross the exchange boundary: seed their
+            # arrival windows here (the init_state convention)
+            tr = {**tr, "insert": obs_trace.wadd_flat(
+                tr["insert"][0], TR.window_of(ring.arr), ok
+            )[None]}
+        # --- owner side: client bookkeeping ---
+        own = ring.valid & (lenv.g2p[gc] == myrow)
+        cs = jnp.clip(lenv.g2s[gc], 0, CM - 1)  # [R]
+        bidx = jnp.arange(NR_ING, dtype=jnp.int32)
+        rs = (ring.rifl[:, None] - 1 + bidx[None, :]) % CT  # [R, NR]
+        en = own[:, None] & (bidx[None, :] < ring.cnt[:, None])
+        cs_b = jnp.where(en, jnp.broadcast_to(cs[:, None], rs.shape), CM)
+        rs0 = (ring.rifl - 1) % CT
+        cs_m = jnp.where(own, cs, CM)
+        st = st._replace(
+            c_sub_time=st.c_sub_time.at[0, cs_b, rs].set(
+                ring.iss, mode="drop"
+            ),
+            c_fin=st.c_fin.at[0, cs_b, rs].set(0, mode="drop"),
+            c_bcount=st.c_bcount.at[0, cs_m, rs0].set(
+                jnp.clip(ring.cnt, 1, max(NR_ING, 1)), mode="drop"
+            ),
+            # fresh partial-result count for the merged command
+            # (AggregatePending::wait_for — the closed world resets this
+            # in _register_submits/b_client; ingress resets at inject)
+            c_got=st.c_got.at[0, cs_m, rs0].set(0, mode="drop"),
+            c_issued=st.c_issued.at[0, cs_m].add(
+                jnp.where(own, ring.cnt, 0), mode="drop"
+            ),
+        )
+        if TR is not None and tr is not None and "issued" in tr:
+            # issuance bins at each constituent's ISSUE instant (the
+            # lockstep tick-instant convention), not the arrival
+            w_i = jnp.where(en, TR.window_of(ring.iss), TR.max_windows)
+            g_b = jnp.broadcast_to(
+                lenv.cl_group[myrow, cs][:, None], rs.shape
+            )
+            tr = {**tr, "issued": tr["issued"].at[0].set(
+                tr["issued"][0].at[w_i, g_b].add(1, mode="drop")
+            )}
+        if tr is not st.trace:
+            st = st._replace(trace=tr)
+        return st
+
+    def _pending_cont(st: RState, h):
+        """Replicated: anything to process at or before horizon `h`?"""
+        t_inbox = jnp.where(st.i_valid[0], st.i_time[0], INF_TIME).min()
+        t_local = jnp.minimum(t_inbox, st.per_next[0].min())
+        t_next = jax.lax.pmin(t_local, AXIS)
+        max_step = jax.lax.pmax(st.step[0], AXIS)
+        return (
+            (t_next <= h) & (t_next < INF_TIME)
+            & (max_step < spec.max_steps)
+        )
+
+    def serve_local(st_local: RState, rings: Ring, horizons):
+        """One serve megachunk: K ingress windows per device call — inject
+        ring k, then run the quantum loop bounded by horizon k — and one
+        small Pulse out. The host's conservative contract: every command
+        ISSUED at or before horizon k is in ring 0..k (arrival >= issue,
+        so nothing can arrive in the processed past)."""
+        myrow = jax.lax.axis_index(AXIS)
+
+        def seg(k, st):
+            # fori_loop (not a Python unroll): the quantum program is the
+            # dominant HLO cost, so the serve program stays one-segment
+            # sized however large mega_k is
+            ring_k = jax.tree_util.tree_map(lambda a: a[k], rings)
+            st = _inject(st, ring_k, myrow)
+            h = horizons[k]
+            L = Local(st, *empty_send(), cont=_pending_cont(st, h))
+            L = jax.lax.while_loop(
+                lambda L: L.cont,
+                functools.partial(quantum_step, myrow=myrow, horizon=h),
+                L,
+            )
+            return L.st
+
+        st = jax.lax.fori_loop(0, K_ING, seg, st_local)
+        pulse = Pulse(
+            c_issued=st.c_issued, c_resp=st.c_resp, c_fin=st.c_fin,
+            lat_cnt=st.lat_cnt, lat_sum=st.lat_sum, step=st.step,
+            now=st.now, dropped=st.dropped, faulted=st.faulted,
+            inj_drop=st.inj_drop, next_seq=st.next_seq,
+        )
+        return st, pulse
+
+    def empty_rings() -> Ring:
+        """Host-side zeroed ring template ([K, R] numpy arrays) — the
+        serve runtime fills admitted rows and device_puts the result."""
+        def z(*s):
+            return np.zeros(s, np.int32)
+
+        return Ring(
+            valid=np.zeros((K_ING, R_ING), bool),
+            dst=z(K_ING, R_ING), arr=z(K_ING, R_ING),
+            gcid=z(K_ING, R_ING), rifl=np.ones((K_ING, R_ING), np.int32),
+            cnt=np.ones((K_ING, R_ING), np.int32), ro=z(K_ING, R_ING),
+            keys=z(K_ING, R_ING, KPC), iss=z(K_ING, R_ING, NR_ING),
+            seq=z(K_ING, R_ING),
+        )
+
+    def make_serve(mesh: Mesh, cache=None):
+        """`serve(state, rings, horizons) -> (state, Pulse)`, compiled once
+        (lazily, on first call) for this mesh. The state argument is
+        DONATED — XLA updates the resident serving state in place; the
+        host keeps only the returned handle. `rings` is an `empty_rings`
+        -shaped pytree (host numpy or device arrays — device_put the next
+        megachunk's rings while the current one is in flight for the
+        double-buffer overlap), `horizons` an int32[K]. `cache` (an
+        `ExecutableStore`) warm-starts the serve program from the
+        persistent AOT store, so a fresh server process skips the compile.
+        The compiled program is shared per mesh across calls; the first
+        caller's `cache` wins."""
+        assert ingress is not None, (
+            "build_runner(..., ingress=IngressSpec(...)) builds the"
+            " serving variant"
+        )
+        assert mesh.devices.size == n, (
+            f"serving runner needs one device per process: n={n}, "
+            f"mesh size={mesh.devices.size}"
+        )
+        assert mesh.axis_names == (AXIS,), mesh.axis_names
+        box = _serve_fns.setdefault(mesh, [])
+
+        def build(state):
+            specs = jax.tree_util.tree_map(
+                lambda x: P(AXIS) if jnp.ndim(x) >= 1 else P(), state
+            )
+            ring_specs = Ring(*(P() for _ in Ring._fields))
+            pulse_specs = Pulse(
+                c_issued=P(AXIS), c_resp=P(AXIS), c_fin=P(AXIS),
+                lat_cnt=P(AXIS), lat_sum=P(AXIS), step=P(AXIS), now=P(),
+                dropped=P(AXIS), faulted=P(AXIS), inj_drop=P(AXIS),
+                next_seq=P(AXIS),
+            )
+            fn = jax.jit(
+                _shard_map(
+                    serve_local, mesh=mesh,
+                    in_specs=(specs, ring_specs, P()),
+                    out_specs=(specs, pulse_specs),
+                ),
+                donate_argnums=(0,),
+            )
+            if cache is not None:
+                fn = cache.wrap(fn, program="ingress.serve",
+                                protocol=pdef.name, donation="state")
+            return fn
+
+        def serve(state, rings, horizons):
+            if not box:
+                box.append(build(state))
+            return box[0](state, rings, horizons)
+
+        return serve
+
     class Runner:
         pass
 
     r = Runner()
     r.spec = spec
     r.cm = CM
+    r.ct = CT
     r.client_layout = (cl_present, cl_gcid, cl_group)
     r.lenv = lenv
     r.init_state = init_state
     r.run_sharded = run_sharded
     r.run_local = run_local  # exposed for lowering/compile diagnostics
+    r.ingress = ingress
+    if ING:
+        r.make_serve = make_serve
+        r.empty_rings = empty_rings
+        r.inbox_slots = IP
     return r
 
 

@@ -17,6 +17,8 @@ matplotlib — same families:
 - `trace_timeline`      — per-window channel timelines from a device trace
   report (obs/report.py), the in-run view `recovery_plot` reconstructs
   post-hoc from completion times
+- `latency_percentile_timeline` — p50/p99 over time from the bucketed
+  "lat" channel (the cdf-over-time family; the serving path's headline)
 - `heatmap_plot`        — metric over a 2-D config grid (`heatmap_plot`)
 - `batching_plot`       — throughput/latency vs batch size (`batching_plot`)
 - `metrics_table`       — text table of per-process protocol/executor
@@ -345,6 +347,39 @@ def trace_timeline(
         ax.tick_params(labelsize=7)
     for j in range(len(names), nrows * ncols):
         axes[j // ncols][j % ncols].axis("off")
+    fig.savefig(output, bbox_inches="tight", dpi=150)
+    plt.close(fig)
+    return output
+
+
+def latency_percentile_timeline(
+    report: Dict[str, Any],
+    output: str,
+) -> str:
+    """p50/p99 latency over time from a drained "lat" channel (the
+    cdf-over-time family: obs/report.lat_percentiles per-window series,
+    the serving path's headline figure). `report` is a `drain` output (or
+    any dict with `channels.lat.percentiles`)."""
+    pct = report["channels"]["lat"]["percentiles"]
+    wm = pct["window_ms"]
+    p50 = pct["p50_per_window"]
+    p99 = pct["p99_per_window"]
+    xs = (np.arange(len(p50)) + 0.5) * wm / 1000.0
+    fig, ax = plt.subplots(figsize=(7, 3))
+    for series, label, style in ((p50, "p50", "-"), (p99, "p99", "--")):
+        ys = np.asarray([np.nan if v is None else v for v in series],
+                        float)
+        ax.step(xs, ys, style, where="mid", linewidth=1.2, label=label)
+    ov = pct["overall"]
+    ax.set_title(
+        f"ingress-to-done latency (overall p50 {ov['p50_ms']} ms,"
+        f" p99 {ov['p99_ms']} ms, n={ov['count']})",
+        fontsize=9,
+    )
+    ax.set_xlabel("time (s)", fontsize=8)
+    ax.set_ylabel("latency (ms, bucket upper edge)", fontsize=8)
+    ax.grid(alpha=0.3)
+    ax.legend(fontsize=8)
     fig.savefig(output, bbox_inches="tight", dpi=150)
     plt.close(fig)
     return output

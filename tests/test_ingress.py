@@ -1,0 +1,448 @@
+"""Streaming ingress runtime (fantoch_tpu/ingress + quantum serving mode).
+
+The contract under test, in order of importance:
+
+1. **Deterministic replay inherits the correctness oracles**: the exact
+   command stream a closed-world open-loop run issues
+   (`record_workload_trace`), fed back through the ingress, reproduces the
+   baked-in quantum run's observables bit-for-bit — latency histograms,
+   latency sums/counts, completion counters, protocol commit/GC counters,
+   client-returned values, and the submit/issued/done/lat trace channels.
+   (The insert/deliver channels are engine-relative by construction: the
+   closed world's self-tick records cross the exchange, injected rows do
+   not.)
+2. **Replay determinism**: serving the same trace twice is FULL-STATE
+   bit-identical.
+3. **Flow control**: ring wrap-around (a burst larger than a megachunk's
+   ring capacity spills across windows via deferral and still completes),
+   sliding-rifl-window backpressure, bounded-queue drop policy, and the
+   stall watchdog aborting a wedged feed (crash schedule).
+4. The runner's B=1 contract raises a ValueError carrying the
+   ingress-batching story (satellite of ISSUE 9).
+
+Steady-state host-sync accounting (`syncs_per_megachunk == 1.0`, the
+closed-world megachunk driver's count) is asserted on every serve run.
+"""
+import types
+
+import jax
+import numpy as np
+import pytest
+
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.planet import Planet
+from fantoch_tpu.core.workload import KeyGen, Workload
+from fantoch_tpu.engine import setup
+from fantoch_tpu.ingress import (
+    HostBatcher,
+    ServeRuntime,
+    SyntheticOpenLoopTrace,
+    file_feed,
+    record_workload_trace,
+    socket_feed,
+)
+from fantoch_tpu.obs.trace import TraceSpec, lat_bucket
+from fantoch_tpu.parallel import quantum
+from fantoch_tpu.protocols import basic as basic_proto
+
+REGIONS3 = ["asia-east1", "us-central1", "us-west1"]
+CREGIONS = ["us-west1", "europe-west2"]
+SERVE_CHANNELS = ("submit", "insert", "issued", "done", "lat")
+
+
+def _build(cmds=6, max_seq=128, trace=True, faults=None,
+           open_loop_interval_ms=25):
+    planet = Planet.new()
+    config = Config(n=3, f=1, gc_interval_ms=100)
+    wl = Workload(1, KeyGen.conflict_pool(50, 2), 1, cmds)
+    pdef = basic_proto.make_protocol(3, 1)
+    tspec = (
+        TraceSpec(window_ms=50, max_windows=64, channels=SERVE_CHANNELS)
+        if trace else None
+    )
+    spec = setup.build_spec(
+        config, wl, pdef, n_clients=2, n_client_groups=2, extra_ms=1000,
+        max_steps=5_000_000, max_seq=max_seq,
+        open_loop_interval_ms=open_loop_interval_ms,
+        faults=faults is not None, trace=tspec,
+    )
+    placement = setup.Placement(REGIONS3, CREGIONS, 1)
+    env = setup.build_env(spec, config, planet, placement, wl, pdef,
+                          faults=faults)
+    return spec, pdef, wl, env, tspec
+
+
+@pytest.fixture(scope="module")
+def served():
+    """One shared serving deployment: the closed-world reference run and
+    an ingress runner whose compiled serve program every test in this
+    module reuses (the compile is the dominant cost on this host)."""
+    spec, pdef, wl, env, tspec = _build()
+    mesh = quantum.make_mesh(3)
+    closed = quantum.build_runner(spec, pdef, wl, env)
+    rst = jax.tree_util.tree_map(
+        np.asarray, closed.run_sharded(mesh, closed.init_state())
+    )
+    ing = quantum.build_runner(
+        spec, pdef, wl, env,
+        ingress=quantum.IngressSpec(ring_slots=32, mega_k=2,
+                                    batch_max_size=1),
+    )
+    return types.SimpleNamespace(
+        spec=spec, pdef=pdef, wl=wl, env=env, tspec=tspec, mesh=mesh,
+        closed_state=rst, ing=ing,
+    )
+
+
+def _serve(served, feed, **kw):
+    kw.setdefault("window_ms", 50)
+    kw.setdefault("stall_gap_ms", 30000)
+    rt = ServeRuntime(served.ing, served.mesh, served.env, **kw)
+    report, st = rt.run(feed, max_wall_s=600, max_megachunks=400)
+    return report, jax.tree_util.tree_map(np.asarray, st)
+
+
+# ---------------------------------------------------------------------------
+# satellite: the runner's B=1 contract
+# ---------------------------------------------------------------------------
+
+
+def test_runner_rejects_batched_spec_with_ingress_story():
+    planet = Planet.new()
+    config = Config(n=3, f=1, gc_interval_ms=100)
+    wl = Workload(1, KeyGen.zipf(1.0, 16), 1, 4)
+    pdef = basic_proto.make_protocol(3, setup.command_key_slots(wl, 2))
+    spec = setup.build_spec(
+        config, wl, pdef, n_clients=2, n_client_groups=2,
+        open_loop_interval_ms=10, batch_max_size=2, batch_max_delay_ms=5,
+    )
+    placement = setup.Placement(REGIONS3, CREGIONS, 1)
+    env = setup.build_env(spec, config, planet, placement, wl, pdef)
+    with pytest.raises(ValueError, match="host-side"):
+        quantum.build_runner(spec, pdef, wl, env)
+    with pytest.raises(ValueError, match="ingress"):
+        quantum.build_runner(spec, pdef, wl, env)
+
+
+# ---------------------------------------------------------------------------
+# deterministic replay == the closed-world run (the acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+def test_ingress_replay_bit_identical_to_closed_world(served):
+    report, ist = _serve(
+        served, record_workload_trace(served.spec, served.env, served.wl)
+    )
+    rst = served.closed_state
+    assert report["aborted"] is None
+    assert report["completed"] == report["issued"] == 12
+    # steady state: ONE host sync per megachunk — the closed-world
+    # megachunk driver's count (the trip_profile-style criterion)
+    assert report["syncs_per_megachunk"] == 1.0
+    assert report["host_syncs"] == report["megachunks"]
+    for name, a, b in [
+        ("hist", rst.hist, ist.hist),
+        ("hist_overflow", rst.hist_overflow, ist.hist_overflow),
+        ("lat_sum", rst.lat_sum, ist.lat_sum),
+        ("lat_cnt", rst.lat_cnt, ist.lat_cnt),
+        ("c_resp", rst.c_resp, ist.c_resp),
+        ("c_issued", rst.c_issued, ist.c_issued),
+        ("c_vals", rst.c_vals, ist.c_vals),
+        ("commit_count", rst.proto.commit_count, ist.proto.commit_count),
+        ("gc_stable", rst.proto.gc.stable_count,
+         ist.proto.gc.stable_count),
+        ("trace.submit", rst.trace["submit"], ist.trace["submit"]),
+        ("trace.issued", rst.trace["issued"], ist.trace["issued"]),
+        ("trace.done", rst.trace["done"], ist.trace["done"]),
+        ("trace.lat", rst.trace["lat"], ist.trace["lat"]),
+    ]:
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            err_msg=f"ingress replay diverged from the baked run at {name}",
+        )
+
+
+def test_ingress_replay_rerun_full_state_bit_identical(served):
+    feed = lambda: record_workload_trace(served.spec, served.env, served.wl)
+    _, st1 = _serve(served, feed())
+    _, st2 = _serve(served, feed())
+    for i, (a, b) in enumerate(zip(jax.tree_util.tree_leaves(st1),
+                                   jax.tree_util.tree_leaves(st2))):
+        np.testing.assert_array_equal(
+            a, b, err_msg=f"serve rerun diverged at leaf {i}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# flow control: wrap-around, backpressure, drop policy
+# ---------------------------------------------------------------------------
+
+
+def test_ring_wraparound_burst_completes(served):
+    # 80 commands in one hot 50 ms window: more than a whole megachunk's
+    # ring capacity (2 x 32) AND more than the total in-flight window
+    # (2 slots x CT=6 rifls) — admission must spill across windows via
+    # deferral and still complete everything exactly once
+    feed = SyntheticOpenLoopTrace(
+        clients=80, interval_ms=50, commands_per_client=1, key_space=4,
+        seed=3,
+    )
+    report, ist = _serve(served, feed)
+    assert report["aborted"] is None
+    assert report["completed"] == report["issued"] == 80
+    assert report["deferred"] > 0, "a ring-capacity burst must defer"
+    assert report["dropped_feed"] == 0
+    assert report["syncs_per_megachunk"] == 1.0
+    assert int(ist.lat_cnt.sum()) == 80
+
+
+def test_backpressure_sliding_window_never_overruns(served):
+    # per-slot rifl windows: 40 commands per device slot at CT=6 — the
+    # admission window must keep in-flight rifls within CT of the finished
+    # frontier (a violation corrupts c_sub_time/c_got and shows up as
+    # wrong latency counts or a health abort)
+    feed = SyntheticOpenLoopTrace(
+        clients=8, interval_ms=20, commands_per_client=10, key_space=4,
+        seed=5,
+    )
+    report, ist = _serve(served, feed)
+    assert report["aborted"] is None
+    assert report["completed"] == report["issued"] == 80
+    assert int(ist.lat_cnt.sum()) == 80
+    assert int(ist.dropped.sum()) == 0
+
+
+def test_feed_time_origin_rebased(served):
+    # an epoch-style time origin must not make the serve crawl through
+    # empty windows: the first command rebases the feed to the sim clock
+    # (whole windows, so within-window phase is preserved)
+    feed = SyntheticOpenLoopTrace(
+        clients=8, interval_ms=50, commands_per_client=1, key_space=4,
+        seed=13, start_ms=10_000_000,
+    )
+    report, _ = _serve(served, feed)
+    assert report["aborted"] is None
+    assert report["completed"] == report["issued"] == 8
+    assert report["feed_t_shift_ms"] == 10_000_000
+    assert report["megachunks"] < 30, "origin rebase must skip the gap"
+
+
+def test_mid_stream_idle_gap_compressed(served):
+    import itertools
+
+    a = SyntheticOpenLoopTrace(clients=6, interval_ms=50,
+                               commands_per_client=1, key_space=4, seed=21)
+    b = SyntheticOpenLoopTrace(clients=6, interval_ms=50,
+                               commands_per_client=1, key_space=4, seed=22,
+                               start_ms=5_000_000)
+    report, _ = _serve(served, itertools.chain(a.batches(), b.batches()))
+    assert report["aborted"] is None
+    assert report["completed"] == report["issued"] == 12
+    assert report["megachunks"] < 60, \
+        "a mid-stream idle gap must be compressed, not crawled through"
+
+
+def test_batch_wider_than_rifl_window_rejected(served):
+    ing = quantum.build_runner(
+        served.spec, served.pdef, served.wl, served.env,
+        ingress=quantum.IngressSpec(ring_slots=8, mega_k=1,
+                                    batch_max_size=served.spec
+                                    .commands_per_client + 1),
+    )
+    with pytest.raises(ValueError, match="rifl window"):
+        ServeRuntime(ing, served.mesh, served.env)
+
+
+def test_bounded_queue_drop_policy(served):
+    feed = SyntheticOpenLoopTrace(
+        clients=60, interval_ms=50, commands_per_client=1, key_space=4,
+        seed=7,
+    )
+    report, _ = _serve(served, feed, overflow="drop", max_queue=8)
+    assert report["aborted"] is None
+    assert report["dropped_feed"] > 0, "an 8-deep queue must drop a burst"
+    assert report["completed"] == report["issued"]
+    assert report["completed"] + report["dropped_feed"] == 60
+
+
+# ---------------------------------------------------------------------------
+# host batcher (reference merge semantics) + stream sources
+# ---------------------------------------------------------------------------
+
+
+def test_host_batcher_merge_and_flush_rules():
+    b = HostBatcher(batch_max_size=3, batch_max_delay_ms=40, key_slots=3)
+    assert b.add(0, 0, [7], False) == []
+    assert b.add(0, 10, [8], True) == []
+    (m,) = b.add(0, 20, [9], False)  # full flush
+    assert (m.rifl, m.cnt, m.t_submit) == (1, 3, 20)
+    assert list(m.keys) == [7, 8, 9]
+    assert list(m.iss[:3]) == [0, 10, 20]
+    assert m.ro is False
+    # age flush: one command sits past the delay
+    assert b.add(0, 30, [5], True) == []
+    (m2,) = b.flush_due(now=70)
+    assert (m2.rifl, m2.cnt) == (4, 1)
+    assert m2.ro is True
+    assert list(m2.keys) == [5, 5, 5], "unused slots repeat the last key"
+    # the aged trigger also fires on add (the engine's rule)
+    assert b.add(1, 0, [1], False) == []
+    (m3,) = b.add(1, 40, [2], False)
+    assert (m3.gcid, m3.cnt) == (1, 2)
+    # end-of-stream flush
+    b.add(2, 5, [3], False)
+    (m4,) = b.flush_all(now=6)
+    assert (m4.gcid, m4.cnt, m4.rifl) == (2, 1, 1)
+    assert b.pending == 0
+
+
+def test_synthetic_trace_replayable_and_ordered():
+    tr = SyntheticOpenLoopTrace(clients=1000, interval_ms=100,
+                                commands_per_client=2, key_space=64,
+                                seed=11)
+    a = list(tr.batches())
+    b = list(tr.batches())
+    assert len(a) == len(b)
+    total = 0
+    last_t = -1
+    for ba, bb in zip(a, b):
+        np.testing.assert_array_equal(ba.t_ms, bb.t_ms)
+        np.testing.assert_array_equal(ba.client, bb.client)
+        np.testing.assert_array_equal(ba.keys, bb.keys)
+        np.testing.assert_array_equal(ba.read_only, bb.read_only)
+        assert int(ba.t_ms.min()) >= last_t, "feed must be time-ordered"
+        last_t = int(ba.t_ms.max())
+        total += ba.count
+        assert int(ba.keys.max()) < 64
+    assert total == tr.total_commands == 2000
+
+
+def test_file_and_socket_feeds(tmp_path):
+    import json
+    import socket
+    import threading
+
+    lines = [
+        json.dumps({"t": 5 * i, "client": i % 3, "keys": [i % 7],
+                    "ro": i % 2})
+        for i in range(10)
+    ]
+    path = tmp_path / "feed.jsonl"
+    path.write_text("\n".join(lines) + "\n")
+    batches = list(file_feed(str(path), batch=4))
+    assert sum(b.count for b in batches) == 10
+    assert int(batches[0].t_ms[0]) == 0 and bool(batches[0].read_only[1])
+
+    listener = socket.create_server(("127.0.0.1", 0))
+    port = listener.getsockname()[1]
+
+    def client():
+        with socket.create_connection(("127.0.0.1", port), timeout=10) as c:
+            c.sendall(("\n".join(lines) + "\n").encode())
+
+    t = threading.Thread(target=client, daemon=True)
+    t.start()
+    sbatches = list(socket_feed(listener=listener, batch=4, timeout_s=10))
+    t.join(timeout=10)
+    assert sum(b.count for b in sbatches) == 10
+    for fa, fb in zip(batches, sbatches):
+        np.testing.assert_array_equal(fa.t_ms, fb.t_ms)
+        np.testing.assert_array_equal(fa.keys, fb.keys)
+
+
+def test_lat_bucket_edges():
+    lats = np.asarray([0, 1, 2, 3, 6, 7, 14, 15, 1_000_000])
+    got = np.asarray(lat_bucket(lats, 8))
+    # bucket b covers [2^b - 1, 2^(b+1) - 1); the last bucket absorbs
+    np.testing.assert_array_equal(got, [0, 1, 1, 2, 2, 3, 3, 4, 7])
+
+
+# ---------------------------------------------------------------------------
+# liveness: the stall watchdog aborts a wedged feed
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_stall_watchdog_aborts_wedged_feed():
+    from fantoch_tpu.engine import faults as faults_mod
+
+    # processes 1 and 2 crash permanently at t=0: >f failures, so no
+    # quorum ever forms — submits are admitted but can never complete;
+    # process 0's timers keep simulated time advancing, so the liveness
+    # alarm (live_stall_gap_ms over the drained completion series) fires
+    sched = faults_mod.FaultSchedule(
+        crash={1: (0, None), 2: (0, None)}
+    )
+    spec, pdef, wl, env, _ = _build(trace=False, faults=sched)
+    ing = quantum.build_runner(
+        spec, pdef, wl, env,
+        ingress=quantum.IngressSpec(ring_slots=16, mega_k=2,
+                                    batch_max_size=1),
+    )
+    mesh = quantum.make_mesh(3)
+    rt = ServeRuntime(ing, mesh, env, window_ms=50, stall_gap_ms=600)
+    feed = SyntheticOpenLoopTrace(
+        clients=2, interval_ms=25, commands_per_client=2, key_space=4,
+        seed=1,
+    )
+    report, _ = rt.run(feed, max_wall_s=600, max_megachunks=200)
+    assert report["stall_abort"] is True
+    assert report["aborted"] == "stall"
+    assert report["stall_gap_ms"] > 600
+    assert report["completed"] < report["issued"]
+
+
+# ---------------------------------------------------------------------------
+# host-side batching through the device (unbatch attribution)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_host_batched_serve_unbatches_per_constituent():
+    from fantoch_tpu.exp.serve import run_serve
+
+    rep = run_serve(
+        "basic", 3, 1,
+        logical_clients=12, commands_per_client=4, interval_ms=20,
+        rifl_window=32, ring_slots=32, mega_k=2, window_ms=50,
+        clients_per_region=2, key_space=16,
+        batch=2, batch_delay_ms=15,
+        max_wall_s=600,
+    )
+    assert rep["aborted"] is None
+    # every LOGICAL command completes and gets its own latency record,
+    # while fewer merged submits hit the protocol (the batcher merged)
+    assert rep["completed"] == rep["issued"] == 48
+    assert rep["merged_submits"] < 48
+    assert rep["latency"]["overall"]["count"] == 48
+    assert rep["syncs_per_megachunk"] == 1.0
+
+
+@pytest.mark.slow
+def test_cache_warm_bench_shapes_cli(tmp_path):
+    """`cache warm --bench-shapes` primes the bench's exact smoke-shape
+    programs from outside the bench process: cold run misses, warm run
+    hits (the serving-worker/CI pre-warm path)."""
+    import json
+    import subprocess
+    import sys as _sys
+
+    def run_warm():
+        return subprocess.run(
+            [_sys.executable, "-m", "fantoch_tpu", "cache", "warm",
+             "--bench-shapes", "--smoke", "--protocols", "basic",
+             "--dir", str(tmp_path)],
+            capture_output=True, text=True, timeout=900,
+            env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+        )
+
+    r1 = run_warm()
+    assert r1.returncode == 0, r1.stderr[-2000:]
+    out1 = json.loads(r1.stdout.strip().splitlines()[-1])
+    d1 = out1["bench_shapes"]["basic"]["delta"]
+    assert d1 and d1.get("misses", 0) > 0, out1
+    r2 = run_warm()
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    out2 = json.loads(r2.stdout.strip().splitlines()[-1])
+    d2 = out2["bench_shapes"]["basic"]["delta"]
+    assert d2 and d2.get("hits", 0) > 0 and d2.get("misses", 0) == 0, out2

@@ -395,3 +395,46 @@ def test_trace_report_and_db_roundtrip(tmp_path):
         assert "done" in e.traces and "submit" in e.traces
         assert int(e.traces["done"].sum()) == 8
         assert e.traces["done"].shape[0] == 32
+
+
+def test_lat_channel_percentiles_off_device(tmp_path):
+    """The bucketed per-window latency channel ([W, G, LB], opt-in via
+    TraceSpec.channels): totals must equal the run's own latency record
+    count, per-window sums must match the done channel, and the drained
+    report must derive p50/p99 (obs/report.lat_percentiles — ROADMAP
+    item 5's rider, the serving path's off-device percentile source)."""
+    from fantoch_tpu.obs.trace import DEFAULT_CHANNELS
+
+    spec0, pdef, wl, env = _build("basic")
+    spec1 = dataclasses.replace(
+        spec0, trace=dataclasses.replace(
+            TSPEC, channels=DEFAULT_CHANNELS + ("lat",)
+        )
+    )
+    st = _run(spec1, pdef, wl, env)
+    summary.check_sim_health(st)
+    lat = np.asarray(st.trace["lat"])  # [W, G, LB]
+    assert lat.ndim == 3 and lat.shape[2] == spec1.trace.lat_buckets
+    assert int(lat.sum()) == int(np.asarray(st.lat_cnt).sum())
+    # window-by-window the lat channel counts exactly the completions
+    np.testing.assert_array_equal(
+        lat.sum(axis=2), np.asarray(st.trace["done"])
+    )
+    # bucketed mean bounds the true mean (power-of-two upper edges)
+    rep = obs_report.drain(st, spec1.trace, CREGIONS)
+    pct = rep["channels"]["lat"]["percentiles"]
+    assert pct["overall"]["count"] == int(np.asarray(st.lat_cnt).sum())
+    true_mean = (
+        int(np.asarray(st.lat_sum).sum())
+        / max(int(np.asarray(st.lat_cnt).sum()), 1)
+    )
+    assert pct["overall"]["p99_ms"] >= pct["overall"]["p50_ms"] > 0
+    assert pct["overall"]["p99_ms"] >= true_mean / 2
+    # the cdf-over-time figure family renders from the same report
+    from fantoch_tpu.plot.plots import latency_percentile_timeline
+
+    fig = latency_percentile_timeline(rep, str(tmp_path / "lat.png"))
+    assert os.path.exists(fig)
+    # enabling the channel must not perturb the simulation itself
+    st0 = _run(spec0, pdef, wl, env)
+    _assert_sim_equal(st0, st)

@@ -1,0 +1,161 @@
+"""Serve-path baseline measurement (BASELINE.md round-9 methodology).
+
+Two runs through ONE compiled serving deployment (same runner, same serve
+executable), reported as one JSON object:
+
+- **saturated**: a bounded megachunk slice of a MILLION-client synthetic
+  open-loop trace (ingress saturated by construction — the bounded queue
+  defers the feed, so the measured number is the device-bound serve
+  throughput): sustained commands/sec and commands/sec/chip over the
+  slice, plus the steady-state host-sync count per megachunk (must be
+  1.0 — the closed-world megachunk driver's count).
+- **at_capacity**: a load the deployment sustains without deferral, for
+  clean ingress-to-done p50/p99 (off the device's bucketed per-window
+  latency channel, obs/report.lat_percentiles).
+
+Usage: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+       JAX_PLATFORMS=cpu python tools/serve_baseline.py [--megachunks 30]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fantoch_tpu.__main__ import _force_host_mesh  # noqa: E402 — pre-jax
+
+_force_host_mesh()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--megachunks", type=int, default=30,
+                    help="saturated-slice length in megachunks")
+    ap.add_argument("--clients", type=int, default=1_000_000)
+    ap.add_argument("--slots-per-region", type=int, default=16)
+    ap.add_argument("--rifl-window", type=int, default=64)
+    ap.add_argument("--ring-slots", type=int, default=512)
+    ap.add_argument("--mega-k", type=int, default=4)
+    ap.add_argument("--window", type=int, default=100)
+    ap.add_argument("--max-commands", type=int, default=16384)
+    ap.add_argument("--capacity-clients", type=int, default=1000,
+                    help="at-capacity run: logical clients")
+    ap.add_argument("--capacity-interval", type=int, default=500)
+    ap.add_argument("--capacity-commands", type=int, default=2,
+                    help="at-capacity run: commands per client")
+    ap.add_argument("--aot-cache", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    from fantoch_tpu.exp.serve import build_serving, drain_serve_trace
+    from fantoch_tpu.ingress import ServeRuntime, SyntheticOpenLoopTrace
+
+    cache = None
+    if args.aot_cache:
+        from fantoch_tpu.cache import ExecutableStore, ensure_native_cache
+
+        ensure_native_cache()
+        cache = ExecutableStore()
+
+    runner, mesh, spec, env, pdef, wl, tspec = build_serving(
+        "basic", 3, 1,
+        clients_per_region=args.slots_per_region,
+        rifl_window=args.rifl_window,
+        max_commands=args.max_commands,
+        interval_ms=100,
+        key_space=256,
+        ring_slots=args.ring_slots,
+        mega_k=args.mega_k,
+        trace_window_ms=args.window,
+        trace_windows=512,
+    )
+    out = {
+        "backend": jax.default_backend(),
+        "devices": int(mesh.devices.size),
+        "deployment": {
+            "protocol": "basic", "n": 3,
+            "client_slots": spec.n_clients,
+            "rifl_window": args.rifl_window,
+            "ring_slots": args.ring_slots,
+            "mega_k": args.mega_k,
+            "window_ms": args.window,
+        },
+    }
+
+    # -- run 1: saturated slice of the million-client trace ----------------
+    trace = SyntheticOpenLoopTrace(
+        clients=args.clients, interval_ms=100, commands_per_client=1,
+        key_space=256, seed=9,
+    )
+    rt = ServeRuntime(runner, mesh, env, window_ms=args.window,
+                      stall_gap_ms=60000, overflow="defer",
+                      max_queue=4 * args.ring_slots * args.mega_k,
+                      cache=cache)
+    t0 = time.time()
+    rep, st = rt.run(trace, max_megachunks=args.megachunks)
+    # drop the compile-dominated first dispatch from the sustained rate:
+    # use the telemetry's completion deltas over the warm tail
+    tel = rep.get("telemetry") or []
+    out["saturated"] = {
+        "trace_clients": args.clients,
+        "megachunks": rep["megachunks"],
+        "issued": rep["issued"],
+        "completed": rep["completed"],
+        "deferred": rep["deferred"],
+        "syncs_per_megachunk": rep["syncs_per_megachunk"],
+        "wall_s": rep["wall_s"],
+        "commands_per_sec": rep["commands_per_sec"],
+        "commands_per_sec_per_chip": rep["commands_per_sec_per_chip"],
+        "sim_ms": rep["sim_ms"],
+        "wall_total_s": round(time.time() - t0, 1),
+        "aborted": rep["aborted"],
+    }
+    if len(tel) >= 3:
+        # warm sustained rate: completions over the last 2/3 of dispatches
+        cut = len(tel) // 3
+        dc = tel[-1]["completed"] - tel[cut]["completed"]
+        # wall per megachunk from the timed loop minus the first dispatch
+        warm_wall = rep["wall_s"] * (len(tel) - cut) / max(len(tel), 1)
+        out["saturated"]["warm_commands_per_sec"] = round(
+            dc / max(warm_wall, 1e-9), 1
+        )
+        out["saturated"]["warm_commands_per_sec_per_chip"] = round(
+            dc / max(warm_wall, 1e-9) / out["devices"], 1
+        )
+
+    print(f"saturated slice done: {json.dumps(out['saturated'])}",
+          file=sys.stderr, flush=True)
+
+    # -- run 2: at-capacity load for clean p50/p99 --------------------------
+    sustain = SyntheticOpenLoopTrace(
+        clients=args.capacity_clients,
+        interval_ms=args.capacity_interval,
+        commands_per_client=args.capacity_commands,
+        key_space=256, seed=10,
+    )
+    rt2 = ServeRuntime(runner, mesh, env, window_ms=args.window,
+                       stall_gap_ms=60000, cache=cache)
+    rep2, st2 = rt2.run(sustain, max_wall_s=1800, max_megachunks=600)
+    lat = drain_serve_trace(st2, tspec).get("latency", {})
+    out["at_capacity"] = {
+        "trace_clients": args.capacity_clients,
+        "issued": rep2["issued"],
+        "completed": rep2["completed"],
+        "deferred": rep2["deferred"],
+        "mean_latency_ms": rep2["mean_latency_ms"],
+        "p50_ms": (lat.get("overall") or {}).get("p50_ms"),
+        "p99_ms": (lat.get("overall") or {}).get("p99_ms"),
+        "syncs_per_megachunk": rep2["syncs_per_megachunk"],
+        "aborted": rep2["aborted"],
+    }
+    if cache is not None:
+        out["cache"] = cache.stats()
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
