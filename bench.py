@@ -158,6 +158,7 @@ def budget_left():
 
 
 from fantoch_tpu import cache as aot_cache
+from fantoch_tpu import telemetry as tele
 from fantoch_tpu.core.config import Config
 from fantoch_tpu.core.planet import Planet
 from fantoch_tpu.core.workload import KeyGen, Workload
@@ -532,13 +533,21 @@ def timed_run(pdef, n_configs, commands_per_client, window, chunk_steps,
     dispatches = 0
     done = False
     stall_gap = None
+    # host telemetry (fantoch_tpu/telemetry): span-time every megachunk
+    # dispatch (the device call + its one int8 sync) so the aggregate can
+    # report the host/device wall split per protocol — device_s is the
+    # span sum, host_s the loop's remainder (budget checks, the rare
+    # stall-watchdog pull). Host-side only: the dispatch count and the
+    # compiled program are untouched.
+    reg = tele.MetricsRegistry()
     while not done:
         if budget_left() < 45:
             log("  budget: aborting timed run mid-run (partial events kept)")
             break
-        st, d = mega(envs, st)
+        with reg.span("bench.dispatch"):
+            st, d = mega(envs, st)
+            done = bool(d)  # the ONLY per-dispatch host sync: one int8
         dispatches += 1
-        done = bool(d)  # the ONLY per-dispatch host sync: one int8
         if (not done and tspec is not None and STALL_GAP_MS > 0
                 and STALL_CHECK_EVERY > 0
                 and dispatches % STALL_CHECK_EVERY == 0):
@@ -551,6 +560,9 @@ def timed_run(pdef, n_configs, commands_per_client, window, chunk_steps,
                 break
     jax.block_until_ready(st)
     elapsed = time.time() - t0
+    device_s = reg.histogram("span_us", stage="bench.dispatch").sum / 1e6
+    split = {"device_s": round(device_s, 3),
+             "host_s": round(max(elapsed - device_s, 0.0), 3)}
     res = sweep.summarize_batch(st)
     events = int(res["steps"].sum())
     ok = bool(res["all_done"].all()) and int(res["dropped"].sum()) == 0
@@ -562,7 +574,7 @@ def timed_run(pdef, n_configs, commands_per_client, window, chunk_steps,
         tsum = dict(tsum or {})
         tsum["stall_abort"] = True
         tsum["stall_gap_ms"] = stall_gap
-    return events, elapsed, ok, tsum, cinfo
+    return events, elapsed, ok, tsum, cinfo, split
 
 
 def run_protocol(name, n_configs, commands_per_client, chunk_steps,
@@ -578,6 +590,9 @@ def run_protocol(name, n_configs, commands_per_client, chunk_steps,
     # aggregate JSON, not inferred from wall-clock deltas between rounds
     agg_cache = {"compile_s": 0.0, "hits": 0, "misses": 0, "corrupt": 0,
                  "unserializable": 0}
+    # host/device wall split, summed like agg_cache but kept OUT of the
+    # cache record (the aggregate's "cache" stays cache counters)
+    agg_split = {"host_s": 0.0, "device_s": 0.0}
     while len(rates) < repeats and attempts < repeats + 3:
         attempts += 1
         if rates and budget_left() < 120:
@@ -589,12 +604,14 @@ def run_protocol(name, n_configs, commands_per_client, chunk_steps,
         try:
             # pinned seed: repeats time the SAME workload, so spread
             # measures worker noise, not workload variance
-            events, elapsed, ok, tsum, cinfo = timed_run(
+            events, elapsed, ok, tsum, cinfo, split = timed_run(
                 pdef, B, commands_per_client, window, cs, pool_slots,
                 leader=leader,
             )
             for k in agg_cache:
                 agg_cache[k] = round(agg_cache[k] + cinfo.get(k, 0), 3)
+            for k in agg_split:
+                agg_split[k] = round(agg_split[k] + split.get(k, 0), 3)
         except Exception as e:  # noqa: BLE001
             if "UNAVAILABLE" not in str(e) and "remote_compile" not in str(e) \
                     and "DEADLINE" not in str(e):
@@ -615,13 +632,13 @@ def run_protocol(name, n_configs, commands_per_client, chunk_steps,
             + ("" if ok else "  [INCOMPLETE]"))
     if best is None:
         log(f"  {name}: skipped (no successful run)")
-        return 0, 0.0, False, None, agg_cache
+        return 0, 0.0, False, None, agg_cache, agg_split
     rate, events, elapsed, ok, tsum = best
     spread = (max(rates) - min(rates)) / max(rates) if len(rates) > 1 else 0.0
     log(f"  {name}: best {rate:,.0f} events/sec over {len(rates)} runs "
         f"(spread {spread:.0%}); compile {agg_cache['compile_s']}s,"
         f" cache {agg_cache['hits']}h/{agg_cache['misses']}m")
-    return events, elapsed, ok, tsum, agg_cache
+    return events, elapsed, ok, tsum, agg_cache, agg_split
 
 
 # chunk lengths keep each device call well under the tunnel's ~40s stall
@@ -767,12 +784,14 @@ def worker_main():
                     resp.update(ok=False, err="unknown protocol")
                 else:
                     n_configs, cmds, chunk_steps, pool = shapes
-                    events, elapsed, ok, tsum, cinfo = run_protocol(
+                    events, elapsed, ok, tsum, cinfo, split = run_protocol(
                         name, n_configs, cmds, chunk_steps, pool, repeats,
                     )
                     resp.update(events=events, wall_s=round(elapsed, 3),
                                 ok=bool(ok), trace=tsum, cache=cinfo,
-                                compile_s=cinfo.get("compile_s", 0.0))
+                                compile_s=cinfo.get("compile_s", 0.0),
+                                host_s=split.get("host_s", 0.0),
+                                device_s=split.get("device_s", 0.0))
             else:
                 resp.update(ok=False, err=f"unknown op {op!r}")
         except Exception as e:  # noqa: BLE001 — soft faults stay contained
@@ -1106,6 +1125,8 @@ def main():
                     trace=resp.get("trace"),
                     cache=resp.get("cache"),
                     compile_s=float(resp.get("compile_s", 0.0)),
+                    host_s=float(resp.get("host_s", 0.0)),
+                    device_s=float(resp.get("device_s", 0.0)),
                 )
         all_ok &= bool(rec.get("ok"))
         events, elapsed = rec["events"], rec["wall_s"]
@@ -1120,6 +1141,14 @@ def main():
             # number the executable cache exists to shrink
             "run_s": round(elapsed, 2),
             "compile_s": round(float(rec.get("compile_s") or 0.0), 2),
+            # host/device wall split of the TIMED loop (summed over the
+            # protocol's attempts, like compile_s; compile is off the
+            # clock): device_s is the span-timed dispatch wall (device
+            # call + its one int8 sync), host_s the loop's host-side
+            # remainder (budget checks; the stall-watchdog's rare pull
+            # lands here). Compare warm-vs-warm only — BASELINE.md.
+            "host_s": round(float(rec.get("host_s") or 0.0), 3),
+            "device_s": round(float(rec.get("device_s") or 0.0), 3),
             # AOT store counters for this protocol's attempts: a warm
             # bench must show hits > 0, a cold one misses > 0 (the cache
             # trajectory criterion of tests/test_smoke_bench.py); primed
@@ -1190,16 +1219,30 @@ def main():
                          lint=lint_digest), flush=True)
 
 
+def _argval(flag, default=None):
+    """Value of `--flag VALUE` in this process's argv, or `default`."""
+    argv = sys.argv[1:]
+    if flag in argv:
+        i = argv.index(flag)
+        if i + 1 < len(argv):
+            return argv[i + 1]
+    return default
+
+
 def serve_smoke_main():
     """Tiny streaming-ingress serve on the CPU backend through the AOT
     store — the CI/tier-1 face of the serving path (fantoch_tpu/ingress):
     one parseable JSON line with nonzero completions, zero stall aborts,
     one host sync per megachunk, and the store's hit/miss counters (a
-    warm second run must report hits > 0 for the serve program)."""
+    warm second run must report hits > 0 for the serve program).
+    `--metrics-out PATH` writes the host-telemetry Prometheus textfile
+    (+ .jsonl snapshot stream) every megachunk — CI parses it back and
+    asserts the dispatch span count equals the megachunk count."""
     jax.config.update("jax_platforms", "cpu")
     from fantoch_tpu.exp.serve import run_serve
 
     store = _aot_store()
+    metrics_out = _argval("--metrics-out")
     t0 = time.time()
     try:
         rep = run_serve(
@@ -1216,6 +1259,8 @@ def serve_smoke_main():
             stall_gap_ms=15000,
             max_wall_s=float(os.environ.get("SERVE_SMOKE_WALL_S", "420")),
             cache=store,
+            metrics_out=metrics_out,
+            metrics_interval_s=0.0,
         )
     except Exception as e:  # noqa: BLE001 — one parseable error line
         print(json.dumps(

@@ -169,10 +169,15 @@ def cmd_sweep(args) -> int:
         metrics_log=args.metrics_log or None,
         trace=tspec,
         cache=cache,
+        # run_grid builds the registry itself when metrics_out is set
+        metrics_out=args.metrics_out or None,
+        metrics_interval_s=args.metrics_interval,
     )
     out = {"points": len(points), "dirs": dirs}
     if cache is not None:
         out["cache"] = cache.stats()
+    if args.metrics_out:
+        out["metrics_out"] = args.metrics_out
     print(json.dumps(out))
     return 0
 
@@ -296,6 +301,21 @@ def cmd_serve(args) -> int:
         from .ingress import file_feed
 
         feed = file_feed(args.feed)
+    # host telemetry (fantoch_tpu/telemetry): one registry shared by the
+    # serve runtime's spans/series, the interval textfile exporter, and
+    # the flight recorder; SIGTERM dumps the flight record so a killed
+    # soak stays diagnosable
+    registry = None
+    flight_out = args.flight_out or (
+        args.metrics_out + ".flight.json" if args.metrics_out else ""
+    )
+    if args.metrics_out or flight_out:
+        from .telemetry import (FlightRecorder, MetricsRegistry,
+                                install_sigterm_dump)
+
+        registry = MetricsRegistry()
+        if flight_out:
+            install_sigterm_dump(FlightRecorder(registry, flight_out))
     try:
         report = serve_mod.run_serve(
             args.protocol, args.n, args.f,
@@ -324,6 +344,10 @@ def cmd_serve(args) -> int:
             max_megachunks=args.max_megachunks or None,
             seed=args.seed,
             cache=cache,
+            registry=registry,
+            metrics_out=args.metrics_out or None,
+            metrics_interval_s=args.metrics_interval,
+            flight_path=flight_out or None,
         )
     except Exception as e:  # noqa: BLE001 — one parseable error line
         print(json.dumps({"error": f"{type(e).__name__}: {e}"[:500]}))
@@ -333,6 +357,18 @@ def cmd_serve(args) -> int:
         with open(args.json_out, "w") as f:
             f.write(json.dumps(report))
         print(f"json: {args.json_out}", file=sys.stderr)
+    if args.metrics_plot and args.metrics_out:
+        # host-overhead timeline off the snapshot stream the exporter
+        # appended during the run (plot/plots.py)
+        from .plot.plots import host_overhead_timeline
+
+        snaps = []
+        with open(args.metrics_out + ".jsonl") as f:
+            for line in f:
+                if line.strip():
+                    snaps.append(json.loads(line))
+        host_overhead_timeline(snaps, args.metrics_plot)
+        print(f"figure: {args.metrics_plot}", file=sys.stderr)
     # nonzero exit on an aborted serve so CI/scripts can gate on it
     return 0 if not report.get("aborted") else 1
 
@@ -830,6 +866,13 @@ def main(argv=None) -> int:
                          " fingerprints")
     pw.add_argument("--aot-cache-dir", default="",
                     help="executable-store dir (default: the shared root)")
+    pw.add_argument("--metrics-out", default="",
+                    help="write a Prometheus textfile of the sweep's host"
+                         " telemetry (dispatch spans, bucket progress) on"
+                         " an interval; a .jsonl snapshot stream lands"
+                         " beside it (fantoch_tpu/telemetry)")
+    pw.add_argument("--metrics-interval", type=float, default=10.0,
+                    help="textfile/snapshot write interval seconds")
     pw.set_defaults(fn=cmd_sweep)
 
     pt = sub.add_parser(
@@ -933,6 +976,21 @@ def main(argv=None) -> int:
     pv.add_argument("--aot-cache-dir", default="")
     pv.add_argument("--json", default="", dest="json_out",
                     help="also write the report JSON here")
+    pv.add_argument("--metrics-out", default="",
+                    help="write a Prometheus textfile here on an interval"
+                         " (atomic replace; a .jsonl snapshot stream and"
+                         " a .flight.json crash dump land beside it —"
+                         " fantoch_tpu/telemetry)")
+    pv.add_argument("--metrics-interval", type=float, default=10.0,
+                    help="textfile/snapshot write interval seconds"
+                         " (<= 0 writes every megachunk account)")
+    pv.add_argument("--flight-out", default="",
+                    help="flight-recorder dump path (default:"
+                         " <metrics-out>.flight.json; dumps on"
+                         " ServeHealthError, stall abort, SIGTERM)")
+    pv.add_argument("--metrics-plot", default="",
+                    help="render the host-overhead timeline figure from"
+                         " the run's snapshot stream (needs --metrics-out)")
     pv.set_defaults(fn=cmd_serve)
 
     pl = sub.add_parser(

@@ -358,6 +358,9 @@ def run_grid(
     stats: Optional[Dict[str, int]] = None,
     trace=None,
     cache=None,
+    registry=None,
+    metrics_out: Optional[str] = None,
+    metrics_interval_s: float = 10.0,
 ) -> List[str]:
     """Run every grid point and persist one results dir per shape bucket.
 
@@ -390,12 +393,31 @@ def run_grid(
     resume then distinguishes "same grid, same EXECUTABLE" from "same
     grid, changed program", exactly like the engine-parameter guard.
 
+    `registry` / `metrics_out` (fantoch_tpu/telemetry) span-time the
+    dispatch loop (`sweep.dispatch` per device call, labeled by bucket)
+    and write the Prometheus textfile + `.jsonl` snapshot stream on
+    `metrics_interval_s` — host-side only, zero change to the compiled
+    programs or the per-megachunk sync count.
+
     Returns the created directories (load them with `ResultsDB.load` on the
     parent root)."""
     if metrics_log and not chunk_steps:
         raise ValueError(
             "metrics_log snapshots are taken between chunks; pass chunk_steps"
         )
+    from ..telemetry import NULL_REGISTRY, MetricsRegistry, TextfileExporter
+
+    reg = registry
+    exporter = None
+    if metrics_out:
+        if reg is None:
+            reg = MetricsRegistry()
+        exporter = TextfileExporter(
+            reg, metrics_out, interval_s=metrics_interval_s,
+            jsonl_path=metrics_out + ".jsonl",
+        )
+    if reg is None:
+        reg = NULL_REGISTRY  # the measured no-op fast path
     planet = planet or Planet.new()
     client_regions = list(client_regions or ["us-west1", "us-west2"])
 
@@ -581,9 +603,17 @@ def run_grid(
                     spec, pdef, wl, chunk_steps, cache=cache
                 )
                 st = init(batched)
-                while not done(st):
-                    st = chunk(batched, st)
+                finished = bool(done(st))
+                while not finished:
+                    # the span covers the dispatch AND the done() pull
+                    # (this path's per-chunk host sync), like the
+                    # megachunk path — device wait attributes to the span
+                    with reg.span("sweep.dispatch", bucket=bi):
+                        st = chunk(batched, st)
+                        finished = bool(done(st))
                     _append_metrics_snapshot(metrics_log, bi, st, pdef)
+                    if exporter is not None:
+                        exporter.maybe_write()
                     if verbose:
                         print(
                             f"bucket {bi}: steps "
@@ -599,17 +629,29 @@ def run_grid(
                 st = init(batched)
                 finished = 0
                 while not finished:
-                    st, d = mega(batched, st)
-                    finished = int(d)
+                    # the span covers the dispatch AND the int8 done pull
+                    # (the megachunk's one host sync) — host wall time of
+                    # one device call, exactly the bench's split
+                    with reg.span("sweep.dispatch", bucket=bi):
+                        st, d = mega(batched, st)
+                        finished = int(d)
+                    if exporter is not None:
+                        exporter.maybe_write()
                     if verbose:
                         print(
                             f"bucket {bi}: steps "
                             f"{np.asarray(st.step).sum()}", flush=True
                         )
             else:
-                st = sweep.run_batch(spec, pdef, wl, batched)
+                with reg.span("sweep.dispatch", bucket=bi):
+                    st = sweep.run_batch(spec, pdef, wl, batched)
+                    jax.block_until_ready(st)  # device wait inside the span
+            # chunk/mega branches finish here (their loops synced only the
+            # done flag); a no-op re-wait for the run_batch branch
             jax.block_until_ready(st)
         wall_s = time.perf_counter() - t0
+        reg.gauge("sweep_bucket_wall_s", bucket=bi).set(round(wall_s, 3))
+        reg.counter("sweep_buckets_done_total").inc()
         st = jax.tree_util.tree_map(np.asarray, st)
         B = len(envs)
         st = jax.tree_util.tree_map(lambda x: x[:B], st)  # drop mesh padding
@@ -661,6 +703,8 @@ def run_grid(
                                  client_regions)
         if verbose:
             print(f"bucket {bi} ({bkey}) -> {out_dirs[-1]}", flush=True)
+    if exporter is not None:
+        exporter.write()  # end-of-sweep flush
     return out_dirs
 
 

@@ -179,6 +179,13 @@ def run_serve(
     seed: int = 0,
     faults=None,
     cache=None,
+    # host telemetry (fantoch_tpu/telemetry): registry for spans/series,
+    # Prometheus textfile (+ .jsonl snapshot stream) on an interval, and
+    # the crash flight-recorder dump path
+    registry=None,
+    metrics_out: Optional[str] = None,
+    metrics_interval_s: float = 10.0,
+    flight_path: Optional[str] = None,
 ) -> Dict[str, Any]:
     """One serve run end to end; returns the runtime report + trace drain
     + cache counters. With no `feed`, replays a `SyntheticOpenLoopTrace`
@@ -228,6 +235,10 @@ def run_serve(
         overflow=overflow,
         max_queue=max_queue,
         cache=cache,
+        registry=registry,
+        metrics_out=metrics_out,
+        metrics_interval_s=metrics_interval_s,
+        flight_path=flight_path,
     )
     report, st = rt.run(feed, max_wall_s=max_wall_s,
                         max_megachunks=max_megachunks)
@@ -235,6 +246,12 @@ def run_serve(
     report["n"] = n
     report["devices"] = int(mesh.devices.size)
     report["backend"] = str(mesh.devices.ravel()[0].platform)
+    if rt.registry.enabled:
+        # the host-telemetry invariant consumers assert on: one dispatch
+        # span per dispatched megachunk (rolled-back plans excluded)
+        report["dispatch_spans"] = rt.registry.counter(
+            "spans_total", stage="dispatch"
+        ).value
     report.update(drain_serve_trace(st, tspec))
     if cache is not None:
         report["cache"] = cache.stats()

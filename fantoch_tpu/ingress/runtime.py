@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..telemetry import FlightRecorder, MetricsRegistry, TextfileExporter
 from .batcher import HostBatcher, MergedCmd
 from .stream import TraceBatch
 
@@ -56,12 +57,29 @@ class ServeRuntime:
     arrays for routing). `overflow` is the bounded-queue policy when the
     stream outruns the device: "defer" (stop pulling; commands submit
     later, their measured latency grows) or "drop" (count + discard).
+
+    Host telemetry (fantoch_tpu/telemetry): every megachunk's pipeline
+    stages are span-timed (`host_batch` -> `device_put` -> `dispatch` ->
+    `account` — the account span absorbs the one host sync, so its
+    duration IS the device wait), the report's bounded series are
+    registry-backed, and `metrics_out` adds the interval-written
+    Prometheus textfile + a `.jsonl` snapshot stream beside it. A flight
+    dump (recent spans + counters) lands at `flight_path` (default
+    `<metrics_out>.flight.json`) on ServeHealthError or a stall abort —
+    with the aborted megachunk's spans marked `rolled_back`. Pass a
+    DISABLED registry for the measured no-op path; the device contract
+    (one sync per megachunk, bit-identical programs) is untouched either
+    way.
     """
 
     def __init__(self, runner, mesh, env, *, window_ms: int = 100,
                  stall_gap_ms: int = 15000, overflow: str = "defer",
                  max_queue: int = 100_000, cache=None,
-                 client_map: str = "mod", drain_ms: Optional[int] = None):
+                 client_map: str = "mod", drain_ms: Optional[int] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 metrics_out: Optional[str] = None,
+                 metrics_interval_s: float = 10.0,
+                 flight_path: Optional[str] = None):
         assert overflow in ("defer", "drop"), overflow
         assert runner.ingress is not None, (
             "build the runner with ingress=IngressSpec(...)"
@@ -79,7 +97,12 @@ class ServeRuntime:
         self.ingress = runner.ingress
         self.mesh = mesh
         self.cache = cache
-        self.serve = runner.make_serve(mesh, cache=cache)
+        # the registry exists before make_serve so the serve program's
+        # first-call resolve (cold compile vs warm AOT load) lands in it
+        # as the serve_program_first_call_s gauge
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.serve = runner.make_serve(mesh, cache=cache,
+                                       registry=self.registry)
         self.window_ms = int(window_ms)
         self.stall_gap_ms = int(stall_gap_ms)
         self.overflow = overflow
@@ -125,11 +148,32 @@ class ServeRuntime:
         self.faulted = 0
         self.lat_cnt_total = 0
         self.lat_sum_total = 0
-        # report telemetry (bounded for indefinite serves): the last 8192
-        # completion windows; the live stall check is scalar, see below
-        self._bins: deque = deque(maxlen=8192)
-        self._bins_w0 = 0  # window index of bins[0]
-        self._telemetry: deque = deque(maxlen=256)
+        # host-side telemetry (fantoch_tpu/telemetry): the registry
+        # (created above) owns every bounded report series, the per-stage
+        # dispatch spans, and the counters/gauges the drains read.
+        # Default: a private enabled registry (cheap, host-only — never a
+        # device sync). Pass a DISABLED registry for the measured no-op
+        # fast path: series and spans vanish, the serve contract (one
+        # sync per megachunk) is untouched.
+        reg = self.registry
+        # report series (bounded for indefinite serves): the last 8192
+        # completion windows + the last 256 accounting snapshots; the live
+        # stall check is scalar, see below
+        self._bins = reg.window_series("serve_completions", maxlen=8192)
+        self._tele = reg.series("serve_telemetry", maxlen=256)
+        self._exporter = (
+            TextfileExporter(reg, metrics_out,
+                             interval_s=metrics_interval_s,
+                             jsonl_path=metrics_out + ".jsonl")
+            if metrics_out else None
+        )
+        # flight recorder: recent spans + a counter snapshot, dumped on
+        # ServeHealthError / stall abort (SIGTERM is the CLI's hook)
+        if flight_path is None and metrics_out:
+            flight_path = metrics_out + ".flight.json"
+        self._flight = (
+            FlightRecorder(reg, flight_path) if flight_path else None
+        )
         # liveness reference: the last instant the serve provably made
         # progress (a completion landed) or had nothing outstanding — an
         # idle feed span must not read as a stall once work resumes.
@@ -372,21 +416,19 @@ class ServeRuntime:
         self.lat_cnt_total = int(np.asarray(p.lat_cnt).sum())
         self.lat_sum_total = int(np.asarray(p.lat_sum).sum())
         w = max(0, self.sim_now // self.window_ms)
-        # bounded per-window report series: deque drops the oldest
-        # windows; self._bins_w0 tracks the window index of bins[0]
-        while self._bins_w0 + len(self._bins) <= w:
-            if len(self._bins) == self._bins.maxlen:
-                self._bins_w0 += 1
-            self._bins.append(0)
-        self._bins[w - self._bins_w0] += delta
+        # bounded per-window report series (registry-backed: the oldest
+        # windows drop; `.base` tracks the window index of element 0)
+        self._bins.add_at(w, delta)
         if delta > 0 or self.admitted_logical <= self.completed_logical:
             self._last_progress_ms = self.sim_now
-        self._telemetry.append({
+        self._tele.append({
             "sim_ms": self.sim_now,
             "issued": int(np.asarray(p.c_issued).sum()),
             "completed": completed,
             "steps": int(np.asarray(p.step).sum()),
         })
+        self.registry.counter("serve_host_syncs_total").inc()
+        self._set_gauges()
         cfin = np.asarray(p.c_fin)  # [n, CM, CT]
         for g, adm_r in snap.items():
             f = self.fin.get(g, 0)
@@ -394,6 +436,21 @@ class ServeRuntime:
             while f < adm_r and cfin[pdev, s, f % self.CT]:
                 f += 1
             self.fin[g] = f
+
+    def _set_gauges(self) -> None:
+        """Publish the admission counters as registry gauges — what the
+        Prometheus textfile and a flight dump report (re-run after an
+        abort rollback so the drains agree with the report)."""
+        reg = self.registry
+        reg.gauge("serve_issued").set(self.admitted_logical)
+        reg.gauge("serve_completed").set(self.completed_logical)
+        reg.gauge("serve_merged_submits").set(self.merged_admitted)
+        reg.gauge("serve_deferred").set(self.deferred)
+        reg.gauge("serve_dropped_feed").set(self.dropped_feed)
+        reg.gauge("serve_late_pull").set(self.late_pull)
+        reg.gauge("serve_megachunks").set(self.megachunks)
+        reg.gauge("serve_sim_ms").set(self.sim_now)
+        reg.gauge("serve_queued_logical").set(self._queued_logical)
 
     def _stalled(self) -> Optional[float]:
         if self.stall_gap_ms <= 0:
@@ -407,6 +464,17 @@ class ServeRuntime:
         # reads as a stall once work resumes
         gap = float(self.sim_now - self._last_progress_ms)
         return gap if gap > self.stall_gap_ms else None
+
+    def _rollback(self, pre_plan, idx: int) -> None:
+        """Undo a planned-but-never-dispatched megachunk: restore the
+        admission counters snapshotted before its plan, mark its spans
+        `rolled_back` (they stay visible in a flight dump but must not
+        read as dispatched work), and republish the gauges so every drain
+        agrees with the report."""
+        (self.admitted_logical, self.merged_admitted,
+         self.deferred, self.adm, self._dots_used) = pre_plan
+        self.registry.mark_rolled_back(megachunk=idx)
+        self._set_gauges()
 
     def _complete(self) -> bool:
         return (
@@ -435,55 +503,93 @@ class ServeRuntime:
         stall_gap: Optional[float] = None
         t = 0
         t0 = time.perf_counter()
-        while True:
-            # snapshot the admission counters: a megachunk planned but
-            # never dispatched (an abort lands between plan and dispatch)
-            # must not inflate the report's issued/deferred numbers
-            pre_plan = (self.admitted_logical, self.merged_admitted,
-                        self.deferred, dict(self.adm),
-                        dict(self._dots_used))
-            rings, horizons = self._plan(t)
-            # H2D of the NEXT megachunk's rings overlaps the in-flight
-            # megachunk (async dispatch): the double-buffered submit path
-            rings_dev = jax.device_put(rings)
-            hz_dev = jnp.asarray(horizons, jnp.int32)
+        reg = self.registry
+        try:
+            while True:
+                # snapshot the admission counters: a megachunk planned but
+                # never dispatched (an abort lands between plan and
+                # dispatch) must not inflate the report's issued/deferred
+                # numbers; its spans carry `megachunk=idx` so a rollback
+                # can mark them post-mortem
+                pre_plan = (self.admitted_logical, self.merged_admitted,
+                            self.deferred, dict(self.adm),
+                            dict(self._dots_used))
+                idx = self.megachunks  # index this megachunk gets if sent
+                with reg.span("host_batch", megachunk=idx):
+                    rings, horizons = self._plan(t)
+                # H2D of the NEXT megachunk's rings overlaps the in-flight
+                # megachunk (async dispatch): the double-buffered submit
+                # path. The span times the host-side staging call, not
+                # device compute (the transfer completes asynchronously).
+                with reg.span("device_put", megachunk=idx):
+                    rings_dev = jax.device_put(rings)
+                    hz_dev = jnp.asarray(horizons, jnp.int32)
+                if inflight is not None:
+                    # the account span absorbs the ONE host sync: its
+                    # duration is the wait for the in-flight megachunk —
+                    # the serve loop's device time (dispatch/device_put
+                    # spans are async host calls)
+                    with reg.span("account", megachunk=idx - 1):
+                        self._account(*inflight)
+                    inflight = None
+                    stall_gap = self._stalled()
+                    if stall_gap is not None:
+                        aborted = "stall"
+                        self._rollback(pre_plan, idx)
+                        if self._flight is not None:
+                            self._flight.dump(
+                                "stall_abort",
+                                extra={"stall_gap_ms": stall_gap,
+                                       "megachunk": idx},
+                            )
+                        break
+                if self._complete():
+                    # post-completion drain: keep the horizons advancing
+                    # for drain_ms more simulated time so GC/cleanup
+                    # bookkeeping quiesces like a finished closed-world
+                    # run (extra_ms)
+                    if self._drain_until is None:
+                        self._drain_until = self.sim_now + self.drain_ms
+                    if self.drain_ms <= 0 \
+                            or self.sim_now >= self._drain_until:
+                        break
+                if (max_megachunks is not None
+                        and self.megachunks >= max_megachunks) or (
+                        max_wall_s is not None
+                        and time.perf_counter() - t0 > max_wall_s):
+                    aborted = (
+                        "megachunk_limit"
+                        if max_megachunks is not None
+                        and self.megachunks >= max_megachunks
+                        else "wall_clock"
+                    )
+                    self._rollback(pre_plan, idx)
+                    break
+                snap = dict(self.adm)
+                with reg.span("dispatch", megachunk=idx):
+                    st, pulse = self.serve(st, rings_dev, hz_dev)
+                self.megachunks += 1
+                inflight = (pulse, snap)
+                t = int(horizons[-1])
+                if self._exporter is not None:
+                    self._exporter.maybe_write()
             if inflight is not None:
-                self._account(*inflight)
-                inflight = None
-                stall_gap = self._stalled()
-                if stall_gap is not None:
-                    aborted = "stall"
-                    (self.admitted_logical, self.merged_admitted,
-                     self.deferred, self.adm, self._dots_used) = pre_plan
-                    break
-            if self._complete():
-                # post-completion drain: keep the horizons advancing for
-                # drain_ms more simulated time so GC/cleanup bookkeeping
-                # quiesces like a finished closed-world run (extra_ms)
-                if self._drain_until is None:
-                    self._drain_until = self.sim_now + self.drain_ms
-                if self.drain_ms <= 0 or self.sim_now >= self._drain_until:
-                    break
-            if (max_megachunks is not None
-                    and self.megachunks >= max_megachunks) or (
-                    max_wall_s is not None
-                    and time.perf_counter() - t0 > max_wall_s):
-                aborted = (
-                    "megachunk_limit"
-                    if max_megachunks is not None
-                    and self.megachunks >= max_megachunks
-                    else "wall_clock"
+                with reg.span("account", megachunk=self.megachunks - 1):
+                    self._account(*inflight)
+        except ServeHealthError as e:
+            # a planned-but-never-dispatched megachunk dies here too
+            # (the health guard fires in _plan or in the account of the
+            # previous megachunk): roll its admission back and leave a
+            # post-mortem before propagating
+            self._rollback(pre_plan, self.megachunks)
+            if self._flight is not None:
+                self._flight.dump(
+                    "serve_health_error",
+                    extra={"error": str(e), "megachunk": self.megachunks},
                 )
-                (self.admitted_logical, self.merged_admitted,
-                 self.deferred, self.adm, self._dots_used) = pre_plan
-                break
-            snap = dict(self.adm)
-            st, pulse = self.serve(st, rings_dev, hz_dev)
-            self.megachunks += 1
-            inflight = (pulse, snap)
-            t = int(horizons[-1])
-        if inflight is not None:
-            self._account(*inflight)
+            raise
+        if self._exporter is not None:
+            self._exporter.write()
         wall_s = time.perf_counter() - t0
         n_dev = int(self.mesh.devices.size)
         done = self.completed_logical
@@ -517,9 +623,9 @@ class ServeRuntime:
             "stall_abort": aborted == "stall",
             "stall_gap_ms": stall_gap,
             "aborted": aborted,
-            "completions_per_window": list(self._bins),
-            "completions_window0": self._bins_w0,
+            "completions_per_window": self._bins.list(),
+            "completions_window0": self._bins.base,
             "feed_t_shift_ms": self._t_shift or 0,
-            "telemetry": list(self._telemetry)[-64:],
+            "telemetry": self._tele.list()[-64:],
         }
         return report, st

@@ -1588,7 +1588,7 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             seq=z(K_ING, R_ING),
         )
 
-    def make_serve(mesh: Mesh, cache=None):
+    def make_serve(mesh: Mesh, cache=None, registry=None):
         """`serve(state, rings, horizons) -> (state, Pulse)`, compiled once
         (lazily, on first call) for this mesh. The state argument is
         DONATED — XLA updates the resident serving state in place; the
@@ -1599,7 +1599,11 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         `ExecutableStore`) warm-starts the serve program from the
         persistent AOT store, so a fresh server process skips the compile.
         The compiled program is shared per mesh across calls; the first
-        caller's `cache` wins."""
+        caller's `cache` wins. `registry` (a telemetry `MetricsRegistry`)
+        records the first call's resolve wall — trace + compile on a cold
+        store, deserialize on a warm one — as the
+        `serve_program_first_call_s` gauge, so the AOT warm-start win is
+        measured in-band instead of inferred from dispatch-span outliers."""
         assert ingress is not None, (
             "build_runner(..., ingress=IngressSpec(...)) builds the"
             " serving variant"
@@ -1637,7 +1641,16 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
 
         def serve(state, rings, horizons):
             if not box:
+                import time as _time
+
+                t0 = _time.perf_counter()
                 box.append(build(state))
+                out = box[0](state, rings, horizons)
+                if registry is not None:
+                    registry.gauge("serve_program_first_call_s").set(
+                        round(_time.perf_counter() - t0, 3)
+                    )
+                return out
             return box[0](state, rings, horizons)
 
         return serve

@@ -19,6 +19,8 @@ matplotlib — same families:
   post-hoc from completion times
 - `latency_percentile_timeline` — p50/p99 over time from the bucketed
   "lat" channel (the cdf-over-time family; the serving path's headline)
+- `host_overhead_timeline` — serve-loop stage time (host batch/staging vs
+  device wait) from a telemetry snapshot stream (fantoch_tpu/telemetry)
 - `heatmap_plot`        — metric over a 2-D config grid (`heatmap_plot`)
 - `batching_plot`       — throughput/latency vs batch size (`batching_plot`)
 - `metrics_table`       — text table of per-process protocol/executor
@@ -380,6 +382,58 @@ def latency_percentile_timeline(
     ax.set_ylabel("latency (ms, bucket upper edge)", fontsize=8)
     ax.grid(alpha=0.3)
     ax.legend(fontsize=8)
+    fig.savefig(output, bbox_inches="tight", dpi=150)
+    plt.close(fig)
+    return output
+
+
+def host_overhead_timeline(
+    snapshots: Sequence[Dict[str, Any]],
+    output: str,
+    stages: Sequence[str] = ("host_batch", "device_put", "dispatch",
+                             "account"),
+) -> str:
+    """Where the serve loop's wall clock goes, over the run's lifetime —
+    from a telemetry line-JSON snapshot stream (the `.jsonl` beside
+    `--metrics-out`, fantoch_tpu/telemetry).
+
+    Each band is one pipeline stage's per-interval wall time (diff of the
+    `span_us{stage=...}` histogram sums between consecutive snapshots):
+    `host_batch`/`device_put`/`dispatch` are host-side staging (async
+    calls), `account` is the wait for the in-flight megachunk's Pulse —
+    the one host sync per megachunk, i.e. the device time. A serve whose
+    host bands grow relative to `account` is host-bound: the figure the
+    trip-profile fixed-cost analysis needs for the serving tier."""
+    from ..telemetry import key_str
+
+    snapshots = [s for s in snapshots if isinstance(s, dict)]
+    assert snapshots, "empty snapshot stream"
+    t0 = float(snapshots[0].get("ts", 0.0))
+    ts = []
+    series = {stage: [] for stage in stages}
+    prev = {stage: 0.0 for stage in stages}
+    for snap in snapshots:
+        ts.append(float(snap.get("ts", 0.0)) - t0)
+        hists = snap.get("histograms", {})
+        for stage in stages:
+            cur = hists.get(key_str("span_us", {"stage": stage}), {})
+            cum_s = float(cur.get("sum", 0)) / 1e6
+            series[stage].append(max(cum_s - prev[stage], 0.0))
+            prev[stage] = cum_s
+    fig, ax = plt.subplots(figsize=(7, 3))
+    ax.stackplot(ts, [series[s] for s in stages], labels=list(stages),
+                 alpha=0.85)
+    totals = {s: sum(series[s]) for s in stages}
+    host = sum(v for k, v in totals.items() if k != "account")
+    ax.set_title(
+        f"serve host overhead (host stages {host:.2f}s vs device wait"
+        f" {totals.get('account', 0.0):.2f}s)",
+        fontsize=9,
+    )
+    ax.set_xlabel("wall time (s)", fontsize=8)
+    ax.set_ylabel("stage time per interval (s)", fontsize=8)
+    ax.grid(alpha=0.3)
+    ax.legend(fontsize=7, loc="upper left")
     fig.savefig(output, bbox_inches="tight", dpi=150)
     plt.close(fig)
     return output

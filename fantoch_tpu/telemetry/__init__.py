@@ -1,0 +1,41 @@
+"""Unified host-side telemetry: metrics registry, dispatch spans, and the
+crash flight recorder.
+
+The host-side complement of the device-resident trace recorder (`obs/`):
+counters/gauges/fixed-bucket histograms (power-of-two edges shared with
+`obs/trace.lat_bucket`), wall-clock span timing of the serve/sweep/bench
+pipeline stages, and three drains — an atomically-written Prometheus
+textfile, a line-JSON snapshot stream, and a flight recorder dumped on
+`ServeHealthError` / stall abort / SIGTERM. Pure Python, no jax import:
+instrumentation never touches a traced program or adds a host sync, and a
+disabled registry is a measured no-op fast path.
+
+Wired through `ingress/runtime.py` (serve stages), `exp/harness.py` and
+`bench.py` (dispatch loops), `tools/trip_profile.py` (per-driver timings
+persisted beside the AOT store), and the `serve`/`sweep` CLIs
+(`--metrics-out`, `--metrics-interval`).
+"""
+from .export import (  # noqa: F401
+    TextfileExporter,
+    append_snapshot,
+    parse_textfile,
+    render_prometheus,
+    write_atomic,
+)
+from .flight import (  # noqa: F401
+    FlightRecorder,
+    install_sigterm_dump,
+    load_flight_dump,
+)
+from .registry import (  # noqa: F401
+    NULL_REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    Series,
+    WindowSeries,
+    bucket_of,
+    bucket_upper,
+    key_str,
+)

@@ -1,0 +1,180 @@
+"""Telemetry drains: Prometheus textfile + line-JSON snapshot stream.
+
+Two of the registry's three drains (the third, the crash flight recorder,
+is `flight.py`):
+
+- **Prometheus textfile**: `render_prometheus` emits the registry in the
+  node-exporter textfile-collector format, `write_atomic` publishes it
+  (write-to-temp + `os.replace`, so a scraper never reads a torn file),
+  and `parse_textfile` reads one back — the round-trip CI asserts on a
+  serve-smoke run. Histograms render cumulatively with `le` upper edges
+  from the shared power-of-two bucket scheme.
+- **line-JSON snapshot stream**: one `registry.snapshot()` dict per line,
+  appended on the exporter's interval — the format `bench.py` /
+  `exp/harness.py` aggregates and `plot.plots.host_overhead_timeline`
+  consume (diff consecutive snapshots for per-interval rates).
+
+`TextfileExporter` drives both on a wall-clock interval from whatever loop
+owns the registry (the serve runtime's account step, the sweep bucket
+loop): no background thread, so a crashed process never leaves a writer
+behind, and the write cadence is deterministic under test.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import tempfile
+import time
+from typing import Any, Dict, Optional
+
+from .registry import MetricsRegistry, bucket_upper
+
+__all__ = [
+    "render_prometheus", "parse_textfile", "write_atomic",
+    "TextfileExporter", "append_snapshot",
+]
+
+PREFIX = "fantoch_"
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*(?:\{[^}]*\})?)\s+([-+0-9.eEinfaN]+)$'
+)
+
+
+def _base(key: str) -> str:
+    return key.split("{", 1)[0]
+
+
+def _suffixed(key: str, suffix: str, extra_label: str = "") -> str:
+    """`span_us{stage="x"}` + `_bucket`, `le="3"` ->
+    `span_us_bucket{stage="x",le="3"}` (histogram sub-sample names)."""
+    name, brace, rest = key.partition("{")
+    labels = rest[:-1] if brace else ""
+    if extra_label:
+        labels = f"{labels},{extra_label}" if labels else extra_label
+    return f"{name}{suffix}{{{labels}}}" if labels else f"{name}{suffix}"
+
+
+def render_prometheus(reg: MetricsRegistry, prefix: str = PREFIX) -> str:
+    """The registry as a Prometheus textfile (deterministic ordering)."""
+    snap = reg.snapshot()
+    lines = []
+    seen_types = set()
+
+    def type_line(key: str, kind: str, suffix: str = "") -> None:
+        base = prefix + _base(key) + suffix
+        if base not in seen_types:
+            seen_types.add(base)
+            lines.append(f"# TYPE {base} {kind}")
+
+    for key in sorted(snap["counters"]):
+        type_line(key, "counter")
+        lines.append(f"{prefix}{key} {snap['counters'][key]}")
+    for key in sorted(snap["gauges"]):
+        type_line(key, "gauge")
+        lines.append(f"{prefix}{key} {snap['gauges'][key]}")
+    for key in sorted(snap["histograms"]):
+        h = snap["histograms"][key]
+        type_line(key, "histogram")
+        cum = 0
+        for b, c in enumerate(h["buckets"]):
+            cum += c
+            le = ("+Inf" if b == len(h["buckets"]) - 1
+                  else str(bucket_upper(b)))
+            le_label = 'le="%s"' % le
+            lines.append(
+                f"{prefix}{_suffixed(key, '_bucket', le_label)} {cum}"
+            )
+        lines.append(f"{prefix}{_suffixed(key, '_sum')} {h['sum']}")
+        lines.append(f"{prefix}{_suffixed(key, '_count')} {h['count']}")
+    return "\n".join(lines) + "\n"
+
+
+def parse_textfile(text: str) -> Dict[str, float]:
+    """Parse a Prometheus textfile back into `{sample_key: value}` (keys
+    keep their label sets and the exporter prefix). Raises ValueError on
+    any malformed non-comment line — the round-trip test's teeth."""
+    out: Dict[str, float] = {}
+    for i, line in enumerate(text.splitlines()):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"malformed textfile line {i + 1}: {line!r}")
+        out[m.group(1)] = float(m.group(2))
+    return out
+
+
+def write_atomic(path: str, text: str) -> None:
+    """Publish `text` at `path` atomically (temp file in the same dir +
+    rename): a concurrent reader sees the old file or the new one, never a
+    torn write."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tele_")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def append_snapshot(path: str, reg: MetricsRegistry,
+                    extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Append one snapshot line to the line-JSON stream; returns it."""
+    snap = reg.snapshot()
+    if extra:
+        snap.update(extra)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(snap) + "\n")
+    return snap
+
+
+class TextfileExporter:
+    """Interval-driven drain: `maybe_write()` from the owning loop writes
+    the textfile (atomically) and appends one snapshot line at most every
+    `interval_s` seconds (`interval_s <= 0` = every call); `write()`
+    forces one (the end-of-run flush)."""
+
+    def __init__(self, registry: MetricsRegistry, path: str,
+                 interval_s: float = 10.0,
+                 jsonl_path: Optional[str] = None):
+        self.registry = registry
+        self.path = path
+        self.interval_s = float(interval_s)
+        self.jsonl_path = jsonl_path
+        self.writes = 0
+        self._last = 0.0
+        if jsonl_path:
+            # one run = one stream: truncate at exporter birth so a
+            # reused --metrics-out path never mixes runs (seq would jump
+            # backwards and cumulative sums would drop — breaking the
+            # diff-without-clamping contract and the overhead figure).
+            # Standalone append_snapshot keeps append semantics for
+            # across-run logs (trip_profile's persisted verdicts).
+            d = os.path.dirname(os.path.abspath(jsonl_path))
+            os.makedirs(d, exist_ok=True)
+            open(jsonl_path, "w").close()
+
+    def maybe_write(self) -> bool:
+        now = time.time()
+        if self.writes and now - self._last < self.interval_s:
+            return False
+        self.write()
+        return True
+
+    def write(self) -> None:
+        self._last = time.time()
+        write_atomic(self.path, render_prometheus(self.registry))
+        if self.jsonl_path:
+            append_snapshot(self.jsonl_path, self.registry)
+        self.writes += 1

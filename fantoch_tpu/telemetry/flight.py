@@ -1,0 +1,101 @@
+"""Crash flight recorder: the registry's post-mortem drain.
+
+A long soak that dies tells you nothing unless the host kept notes. The
+flight recorder holds the registry's bounded ring of recent spans plus a
+final counter/gauge/histogram snapshot, and `dump()`s them to disk
+(atomically) when the serve aborts — `ServeHealthError`, a stall-watchdog
+abort, or SIGTERM (`install_sigterm_dump`). The dump honors the serve
+runtime's abort-rollback semantics: spans of a megachunk that was planned
+but never dispatched arrive marked `rolled_back` (the runtime calls
+`registry.mark_rolled_back(megachunk=k)` before dumping), so a post-mortem
+reader can see the staged work without mistaking it for dispatched work.
+
+`load_flight_dump` validates and reloads a dump — the parser side of the
+round trip the tests pin.
+"""
+from __future__ import annotations
+
+import json
+import signal
+import time
+from typing import Any, Dict, Optional
+
+from .export import write_atomic
+from .registry import MetricsRegistry
+
+__all__ = ["FlightRecorder", "load_flight_dump", "install_sigterm_dump"]
+
+FORMAT = "fantoch-flight-v1"
+
+
+class FlightRecorder:
+    """Bind a registry to a dump path. `dump(reason)` is cheap enough to
+    call from an exception path and never raises (a broken disk must not
+    mask the original abort) — it returns the path, or None on failure."""
+
+    def __init__(self, registry: MetricsRegistry, path: str):
+        self.registry = registry
+        self.path = path
+        self.dumps = 0
+
+    def dump(self, reason: str,
+             extra: Optional[Dict[str, Any]] = None) -> Optional[str]:
+        doc = {
+            "format": FORMAT,
+            "reason": reason,
+            "ts": round(time.time(), 6),
+            "snapshot": self.registry.snapshot(),
+            "spans": self.registry.recent_spans(),
+            "extra": extra or {},
+        }
+        try:
+            # default=str: a non-JSON gauge/metadata value (numpy scalar,
+            # Path, ...) degrades to its repr instead of replacing the
+            # original abort with a TypeError
+            write_atomic(self.path, json.dumps(doc, default=str))
+        except Exception:  # noqa: BLE001 — never mask the original abort
+            return None
+        self.dumps += 1
+        return self.path
+
+
+def load_flight_dump(path: str) -> Dict[str, Any]:
+    """Reload + validate a flight dump (ValueError on anything that is not
+    one — a truncated or foreign file must fail loudly, not parse as an
+    empty post-mortem)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or doc.get("format") != FORMAT:
+        raise ValueError(f"{path}: not a flight dump (format != {FORMAT})")
+    for field in ("reason", "snapshot", "spans"):
+        if field not in doc:
+            raise ValueError(f"{path}: flight dump missing {field!r}")
+    if not isinstance(doc["spans"], list) \
+            or not isinstance(doc["snapshot"], dict):
+        raise ValueError(f"{path}: flight dump fields have wrong types")
+    return doc
+
+
+def install_sigterm_dump(recorder: FlightRecorder,
+                         extra: Optional[Dict[str, Any]] = None):
+    """Dump the flight record when the process is SIGTERMed (the soak
+    driver's kill, an OOM reaper's polite phase). Chains to the previously
+    installed Python handler; an ignored disposition (SIG_IGN) stays
+    ignored and a C-level handler (`getsignal` returns None — Python
+    cannot invoke or restore it) is left to its owner — in both cases the
+    dump happens and the process's fate is NOT changed by enabling
+    observability. Only the default disposition exits 143 like the kernel
+    would. Returns the installed handler (tests invoke it directly)."""
+    prev = signal.getsignal(signal.SIGTERM)
+
+    def _handler(signum, frame):
+        recorder.dump("sigterm", extra=extra)
+        if callable(prev):
+            prev(signum, frame)
+        elif prev is signal.SIG_DFL:
+            raise SystemExit(143)
+        # SIG_IGN or a C-level handler (None): dump only, never alter
+        # the process's fate beyond what Python can faithfully chain
+
+    signal.signal(signal.SIGTERM, _handler)
+    return _handler

@@ -1,0 +1,401 @@
+"""Host-side metrics registry: counters, gauges, histograms, bounded
+series, and dispatch spans (one registry per serving/sweep/bench loop;
+the CLI threads one through every drain).
+
+The host half of the observability story. The device half (`obs/`) compiles
+per-window tensors INTO the jitted programs; this registry watches the part
+the device cannot see — the serve pipeline's host stages (host-batch → ring
+`device_put` → dispatch → Pulse account), the sweep/bench dispatch loops,
+ring staging, and the AOT cache — the reference's per-process
+`metrics_logger_task` state, re-homed on the ingress host.
+
+Everything here is pure Python (NO jax import): instrumentation must be
+zero-cost to the device contract — it never touches a traced program, never
+adds a host sync, and a DISABLED registry is a no-op fast path (every
+factory returns a shared null object whose methods do nothing;
+`tools/trip_profile.py --drivers` measures the per-span cost of both
+paths).
+
+Histogram buckets reuse `obs/trace.py`'s power-of-two `lat_bucket` edges —
+bucket b covers `[2^b - 1, 2^(b+1) - 1)` — so host-side latency histograms
+and the device-recorded "lat" channel bin identically and a percentile read
+off either side means the same thing (`tests/test_telemetry.py` pins the
+edge equality against the traced implementation).
+
+Spans are host wall-clock timings of named pipeline stages, recorded into
+(a) a `spans_total{stage=...}` counter, (b) a `span_us{stage=...}`
+histogram, and (c) a bounded ring of recent span records (the flight
+recorder's payload). A span's metadata (e.g. `megachunk=17`) identifies the
+work unit; `mark_rolled_back(megachunk=17)` flags the records of a unit
+that was planned but never dispatched (the serve runtime's abort-rollback
+semantics), so a post-mortem reader never counts rolled-back work as done.
+
+Drains live in `export.py` (Prometheus textfile + line-JSON snapshot
+stream) and `flight.py` (the crash flight recorder).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Tuple
+
+__all__ = [
+    "bucket_of", "bucket_upper", "Counter", "Gauge", "Histogram",
+    "Series", "WindowSeries", "MetricsRegistry", "NULL_REGISTRY",
+    "key_str",
+]
+
+
+def bucket_of(v: int, nb: int) -> int:
+    """Power-of-two bucket index of a non-negative integer value: bucket b
+    covers [2^b - 1, 2^(b+1) - 1), the last bucket absorbs the tail — the
+    EXACT edges of `obs/trace.lat_bucket`, in host arithmetic."""
+    v = int(v)
+    if v < 0:
+        v = 0
+    return min(nb - 1, (v + 1).bit_length() - 1)
+
+
+def bucket_upper(b: int) -> int:
+    """Inclusive upper edge of bucket `b` (mirrors
+    `obs/trace.lat_bucket_upper_ms`)."""
+    return (1 << (b + 1)) - 2
+
+
+def key_str(name: str, labels: Dict[str, Any]) -> str:
+    """Prometheus-style sample key: `name` or `name{k="v",...}` with label
+    keys sorted (deterministic across runs)."""
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+class Counter:
+    """Monotone counter (snapshots may only ever grow)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, v: int = 1) -> None:
+        self.value += v
+
+
+class Gauge:
+    """Last-write-wins scalar."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def set(self, v) -> None:
+        self.value = v
+
+
+class Histogram:
+    """Fixed-bucket histogram over the power-of-two edges above.
+
+    `unit` is documentation (it rides snapshots so a reader knows what the
+    sum means); observations are floored to non-negative integers."""
+
+    __slots__ = ("buckets", "counts", "sum", "count", "unit")
+
+    def __init__(self, buckets: int = 24, unit: str = "us"):
+        self.buckets = int(buckets)
+        self.counts = [0] * self.buckets
+        self.sum = 0
+        self.count = 0
+        self.unit = unit
+
+    def observe(self, v) -> None:
+        v = int(v)
+        self.counts[bucket_of(v, self.buckets)] += 1
+        self.sum += max(v, 0)
+        self.count += 1
+
+
+class Series:
+    """Bounded append-only series of arbitrary (JSON-able) records — the
+    registry-backed replacement for report-telemetry deques (the serve
+    report's `telemetry` list rides one)."""
+
+    __slots__ = ("_d",)
+
+    def __init__(self, maxlen: int):
+        self._d: deque = deque(maxlen=maxlen)
+
+    def append(self, item) -> None:
+        self._d.append(item)
+
+    def list(self) -> List[Any]:
+        return list(self._d)
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+
+class WindowSeries:
+    """Bounded per-window accumulator: `add_at(w, delta)` grows the series
+    to window `w`, dropping the oldest windows past `maxlen` while `base`
+    tracks the window index of element 0 (the serve report's
+    `completions_per_window` / `completions_window0` pair)."""
+
+    __slots__ = ("_d", "base")
+
+    def __init__(self, maxlen: int):
+        self._d: deque = deque(maxlen=maxlen)
+        self.base = 0
+
+    def add_at(self, w: int, delta) -> None:
+        w = max(int(w), self.base)
+        while self.base + len(self._d) <= w:
+            if len(self._d) == self._d.maxlen:
+                self.base += 1
+            self._d.append(0)
+        self._d[w - self.base] += delta
+
+    def list(self) -> List[Any]:
+        return list(self._d)
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+
+# --- null objects: the disabled-registry fast path --------------------------
+
+
+class _NullCounter(Counter):
+    __slots__ = ()
+
+    def inc(self, v: int = 1) -> None:
+        pass
+
+
+class _NullGauge(Gauge):
+    __slots__ = ()
+
+    def set(self, v) -> None:
+        pass
+
+
+class _NullHistogram(Histogram):
+    __slots__ = ()
+
+    def observe(self, v) -> None:
+        pass
+
+
+class _NullSeries(Series):
+    __slots__ = ()
+
+    def __init__(self):
+        super().__init__(1)
+
+    def append(self, item) -> None:
+        pass
+
+
+class _NullWindowSeries(WindowSeries):
+    __slots__ = ()
+
+    def __init__(self):
+        super().__init__(1)
+
+    def add_at(self, w: int, delta) -> None:
+        pass
+
+
+class _NullSpan:
+    """Reusable no-op context manager (no per-call allocation)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_COUNTER = _NullCounter()
+_NULL_GAUGE = _NullGauge()
+_NULL_HISTOGRAM = _NullHistogram()
+_NULL_SERIES = _NullSeries()
+_NULL_WINDOW_SERIES = _NullWindowSeries()
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """Timing context manager: records on exit (exceptions included — an
+    aborted stage still shows up in the flight recorder)."""
+
+    __slots__ = ("_reg", "_stage", "_meta", "_t0")
+
+    def __init__(self, reg: "MetricsRegistry", stage: str, meta):
+        self._reg = reg
+        self._stage = stage
+        self._meta = meta
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._reg._record_span(
+            self._stage, time.perf_counter() - self._t0, self._meta
+        )
+        return False
+
+
+class MetricsRegistry:
+    """One process's (or one runtime's) metric store.
+
+    `enabled=False` turns every factory into a shared-null return and
+    `span()` into a reusable no-op — the fast path a production serve can
+    leave compiled in at zero cost. Metric objects are get-or-create keyed
+    by `(name, sorted labels)`; reads (snapshots, renders) take the same
+    lock the span ring uses, so a drain never sees a half-appended ring."""
+
+    def __init__(self, enabled: bool = True, max_spans: int = 2048):
+        self.enabled = bool(enabled)
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._hists: Dict[str, Histogram] = {}
+        self._series: Dict[str, Series] = {}
+        self._wseries: Dict[str, WindowSeries] = {}
+        self._spans: deque = deque(maxlen=max_spans)
+        # per-stage (counter, histogram) cache: span recording is on the
+        # serve loop's hot path, so skip the label-formatting lookup
+        self._span_stats: Dict[str, Tuple[Counter, Histogram]] = {}
+        self._span_seq = 0
+        self._snap_seq = 0
+        self._t0 = time.time()
+        # REENTRANT: the SIGTERM flight dump runs in the main thread and
+        # snapshots the registry — if the signal lands while the owning
+        # loop holds this lock (an exporter write), a plain Lock would
+        # deadlock the handler and lose the flight record
+        self._lock = threading.RLock()
+
+    # -- factories (get-or-create) ------------------------------------------
+
+    def counter(self, name: str, **labels) -> Counter:
+        if not self.enabled:
+            return _NULL_COUNTER
+        k = key_str(name, labels)
+        c = self._counters.get(k)
+        if c is None:
+            c = self._counters.setdefault(k, Counter())
+        return c
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        if not self.enabled:
+            return _NULL_GAUGE
+        k = key_str(name, labels)
+        g = self._gauges.get(k)
+        if g is None:
+            g = self._gauges.setdefault(k, Gauge())
+        return g
+
+    def histogram(self, name: str, buckets: int = 24, unit: str = "us",
+                  **labels) -> Histogram:
+        if not self.enabled:
+            return _NULL_HISTOGRAM
+        k = key_str(name, labels)
+        h = self._hists.get(k)
+        if h is None:
+            h = self._hists.setdefault(k, Histogram(buckets, unit))
+        return h
+
+    def series(self, name: str, maxlen: int = 256) -> Series:
+        if not self.enabled:
+            return _NULL_SERIES
+        s = self._series.get(name)
+        if s is None:
+            s = self._series.setdefault(name, Series(maxlen))
+        return s
+
+    def window_series(self, name: str, maxlen: int = 8192) -> WindowSeries:
+        if not self.enabled:
+            return _NULL_WINDOW_SERIES
+        s = self._wseries.get(name)
+        if s is None:
+            s = self._wseries.setdefault(name, WindowSeries(maxlen))
+        return s
+
+    # -- spans ---------------------------------------------------------------
+
+    def span(self, stage: str, **meta):
+        """`with reg.span("dispatch", megachunk=k): ...` — time a pipeline
+        stage. Metadata identifies the work unit for `mark_rolled_back`."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, stage, meta)
+
+    def _record_span(self, stage: str, dur_s: float, meta) -> None:
+        dur_us = int(dur_s * 1e6)
+        stats = self._span_stats.get(stage)
+        if stats is None:
+            stats = (self.counter("spans_total", stage=stage),
+                     self.histogram("span_us", stage=stage))
+            self._span_stats[stage] = stats
+        stats[0].inc()
+        stats[1].observe(dur_us)
+        rec = {"stage": stage, "seq": self._span_seq,
+               "t_wall": round(time.time(), 6), "dur_us": dur_us,
+               "rolled_back": False}
+        rec.update(meta)
+        # lock-free on the hot path: deque.append is atomic in CPython and
+        # spans have a single writer (the owning loop); the lock guards
+        # the multi-record reads/mutations (snapshots, rollback marking)
+        self._span_seq += 1
+        self._spans.append(rec)
+
+    def mark_rolled_back(self, **meta) -> int:
+        """Flag every recent span whose metadata matches all of `meta` as
+        `rolled_back` (a planned-but-never-dispatched work unit: its spans
+        stay visible post-mortem but must not read as completed work).
+        Returns the number of spans marked."""
+        n = 0
+        with self._lock:
+            for rec in self._spans:
+                if not rec["rolled_back"] and all(
+                    rec.get(k) == v for k, v in meta.items()
+                ):
+                    rec["rolled_back"] = True
+                    n += 1
+        if n:
+            self.counter("spans_rolled_back_total").inc(n)
+        return n
+
+    def recent_spans(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(r) for r in self._spans]
+
+    # -- snapshots -----------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """One monotone point-in-time view (the line-JSON stream's record):
+        `seq` strictly increases per call, counter values never decrease,
+        histogram counts never decrease — consumers may diff consecutive
+        snapshots without clamping."""
+        with self._lock:
+            self._snap_seq += 1
+            return {
+                "ts": round(time.time(), 6),
+                "seq": self._snap_seq,
+                "uptime_s": round(time.time() - self._t0, 6),
+                "counters": {k: c.value for k, c in self._counters.items()},
+                "gauges": {k: g.value for k, g in self._gauges.items()},
+                "histograms": {
+                    k: {"count": h.count, "sum": h.sum, "unit": h.unit,
+                        "buckets": list(h.counts)}
+                    for k, h in self._hists.items()
+                },
+            }
+
+
+NULL_REGISTRY = MetricsRegistry(enabled=False)

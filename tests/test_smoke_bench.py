@@ -71,6 +71,12 @@ def test_bench_smoke_all_six_protocols(tmp_path):
         assert cache, (name, "missing cache record")
         assert cache["hits"] + cache["misses"] >= 2, (name, cache)
         assert cache["corrupt"] == 0, (name, cache)
+        # host/device wall split of the timed loop (fantoch_tpu/telemetry
+        # dispatch spans): present, non-negative, and the device side is
+        # nonzero whenever the protocol dispatched at all
+        assert rec.get("host_s") is not None, (name, rec)
+        assert rec.get("device_s") is not None, (name, rec)
+        assert rec["host_s"] >= 0 and rec["device_s"] > 0, (name, rec)
 
     # the golden phase primed basic's timed executables into the store
     # inside its side budget, so basic's timed slice LOADED them — the

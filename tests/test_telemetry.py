@@ -1,0 +1,357 @@
+"""Unified host-side telemetry (fantoch_tpu/telemetry).
+
+The contract under test:
+
+1. **Shared bucket scheme**: the host histogram's power-of-two edges are
+   bit-equal to the device recorder's (`obs/trace.lat_bucket`) — a
+   percentile read off either side means the same thing.
+2. **Snapshot monotonicity**: `snapshot()` sequence numbers strictly
+   increase and counter/histogram values never decrease, so consumers may
+   diff consecutive snapshots without clamping.
+3. **Drains round-trip**: Prometheus textfile render -> parse recovers
+   every sample; the flight dump reloads through its validating parser.
+4. **Serve integration**: a metrics-enabled serve still holds
+   `syncs_per_megachunk == 1.0`, records exactly one `dispatch` span per
+   megachunk, and keeps the report's `telemetry`/`completions_per_window`
+   shapes (registry-backed now). A DISABLED registry is a no-op (empty
+   series, no spans) with the serve contract untouched.
+5. **Abort rollback**: a forced `ServeHealthError` leaves a flight dump
+   whose planned-but-never-dispatched megachunk's spans are marked
+   `rolled_back` — and carries no dispatch span for it.
+"""
+import json
+import signal
+import types
+
+import numpy as np
+import pytest
+
+from fantoch_tpu import telemetry as T
+
+# ---------------------------------------------------------------------------
+# registry: buckets, snapshots, spans (pure host — no compiled programs)
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_bucket_edges_match_device_lat_bucket():
+    from fantoch_tpu.obs.trace import lat_bucket, lat_bucket_upper_ms
+
+    vals = np.asarray([0, 1, 2, 3, 6, 7, 14, 15, 127, 128, 1_000_000])
+    for nb in (8, 16, 24):
+        ref = np.asarray(lat_bucket(vals, nb)).tolist()
+        got = [T.bucket_of(int(v), nb) for v in vals]
+        assert got == ref, f"host/device bucket edges diverge at nb={nb}"
+        h = T.Histogram(buckets=nb)
+        for v in vals:
+            h.observe(int(v))
+        dev = np.zeros(nb, np.int64)
+        np.add.at(dev, ref, 1)
+        assert h.counts == dev.tolist()
+        assert h.count == len(vals)
+    for b in range(24):
+        assert T.bucket_upper(b) == lat_bucket_upper_ms(b)
+
+
+def test_registry_snapshot_monotone():
+    reg = T.MetricsRegistry()
+    c = reg.counter("events_total")
+    h = reg.histogram("lat_ms", buckets=8, unit="ms")
+    snaps = []
+    for i in range(5):
+        c.inc(i)
+        h.observe(1 << i)
+        with reg.span("work"):
+            pass
+        snaps.append(reg.snapshot())
+    for a, b in zip(snaps, snaps[1:]):
+        assert b["seq"] > a["seq"], "snapshot seq must strictly increase"
+        assert b["counters"]["events_total"] >= a["counters"]["events_total"]
+        ha = a["histograms"]["lat_ms"]
+        hb = b["histograms"]["lat_ms"]
+        assert hb["count"] >= ha["count"] and hb["sum"] >= ha["sum"]
+        assert all(y >= x for x, y in zip(ha["buckets"], hb["buckets"]))
+    assert snaps[-1]["counters"]['spans_total{stage="work"}'] == 5
+
+
+def test_span_records_and_rollback_marking():
+    reg = T.MetricsRegistry(max_spans=8)
+    with reg.span("host_batch", megachunk=0):
+        pass
+    with reg.span("dispatch", megachunk=0):
+        pass
+    with reg.span("host_batch", megachunk=1):
+        pass
+    with reg.span("device_put", megachunk=1):
+        pass
+    n = reg.mark_rolled_back(megachunk=1)
+    assert n == 2
+    spans = reg.recent_spans()
+    assert [s["seq"] for s in spans] == sorted(s["seq"] for s in spans)
+    for s in spans:
+        assert s["rolled_back"] == (s.get("megachunk") == 1)
+    # rolled-back plans never counted as dispatched
+    assert reg.counter("spans_total", stage="dispatch").value == 1
+    assert reg.counter("spans_rolled_back_total").value == 2
+    # the ring is bounded: 100 more spans keep only the newest 8
+    for i in range(100):
+        with reg.span("x", i=i):
+            pass
+    assert len(reg.recent_spans()) == 8
+
+
+def test_disabled_registry_is_noop():
+    reg = T.MetricsRegistry(enabled=False)
+    reg.counter("a").inc(5)
+    reg.gauge("g").set(3)
+    reg.histogram("h").observe(7)
+    with reg.span("s", megachunk=0):
+        pass
+    s = reg.series("t", 4)
+    s.append({"x": 1})
+    w = reg.window_series("w", 4)
+    w.add_at(3, 2)
+    snap = reg.snapshot()
+    assert snap["counters"] == {} and snap["gauges"] == {}
+    assert snap["histograms"] == {}
+    assert reg.recent_spans() == []
+    assert s.list() == [] and w.list() == [] and w.base == 0
+    # the fast path allocates nothing per call: shared null objects
+    assert reg.counter("a") is reg.counter("b")
+    assert reg.span("x") is reg.span("y")
+
+
+def test_window_series_base_tracking():
+    ws = T.WindowSeries(maxlen=4)
+    ws.add_at(0, 1)
+    ws.add_at(2, 5)
+    assert ws.base == 0 and ws.list() == [1, 0, 5]
+    ws.add_at(6, 2)  # grows past maxlen: oldest windows drop
+    assert ws.base == 3 and ws.list() == [0, 0, 0, 2]
+
+
+# ---------------------------------------------------------------------------
+# drains: Prometheus textfile + jsonl stream + flight dump round trips
+# ---------------------------------------------------------------------------
+
+
+def _populated_registry():
+    reg = T.MetricsRegistry()
+    reg.counter("req_total", proto="basic").inc(7)
+    reg.gauge("inflight").set(3)
+    h = reg.histogram("span_us", stage="dispatch")
+    for v in (5, 100, 3000):
+        h.observe(v)
+    with reg.span("dispatch", megachunk=0):
+        pass
+    return reg
+
+
+def test_prometheus_textfile_roundtrip(tmp_path):
+    reg = _populated_registry()
+    path = tmp_path / "metrics.prom"
+    exp = T.TextfileExporter(reg, str(path), interval_s=0.0,
+                             jsonl_path=str(path) + ".jsonl")
+    exp.write()
+    text = path.read_text()
+    parsed = T.parse_textfile(text)
+    snap = reg.snapshot()
+    for k, v in snap["counters"].items():
+        assert parsed["fantoch_" + k] == v
+    for k, v in snap["gauges"].items():
+        assert parsed["fantoch_" + k] == v
+    # histogram sub-samples: _count/_sum plus cumulative le buckets ending
+    # at +Inf == count
+    hk = 'span_us{stage="dispatch"}'
+    hs = snap["histograms"][hk]
+    assert parsed['fantoch_span_us_count{stage="dispatch"}'] == hs["count"]
+    assert parsed['fantoch_span_us_sum{stage="dispatch"}'] == hs["sum"]
+    assert parsed['fantoch_span_us_bucket{stage="dispatch",le="+Inf"}'] \
+        == hs["count"]
+    with pytest.raises(ValueError, match="malformed"):
+        T.parse_textfile("this is { not a metric\n")
+    # the jsonl stream parses and its seqs are monotone over writes
+    exp.write()
+    lines = [json.loads(x) for x in
+             open(str(path) + ".jsonl").read().splitlines()]
+    assert len(lines) == 2
+    assert lines[1]["seq"] > lines[0]["seq"]
+
+
+def test_flight_recorder_roundtrip(tmp_path):
+    reg = _populated_registry()
+    rec = T.FlightRecorder(reg, str(tmp_path / "flight.json"))
+    p = rec.dump("stall_abort", extra={"stall_gap_ms": 123.0})
+    doc = T.load_flight_dump(p)
+    assert doc["reason"] == "stall_abort"
+    assert doc["extra"]["stall_gap_ms"] == 123.0
+    assert doc["spans"] and doc["spans"][0]["stage"] == "dispatch"
+    assert doc["snapshot"]["counters"]['req_total{proto="basic"}'] == 7
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"not": "a dump"}))
+    with pytest.raises(ValueError, match="flight dump|format"):
+        T.load_flight_dump(str(bad))
+
+
+def test_sigterm_handler_dumps(tmp_path):
+    reg = _populated_registry()
+    rec = T.FlightRecorder(reg, str(tmp_path / "term.json"))
+    prev = signal.getsignal(signal.SIGTERM)
+    try:
+        handler = T.install_sigterm_dump(rec, extra={"who": "test"})
+        assert signal.getsignal(signal.SIGTERM) is handler
+        with pytest.raises(SystemExit):
+            handler(signal.SIGTERM, None)
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+    doc = T.load_flight_dump(str(tmp_path / "term.json"))
+    assert doc["reason"] == "sigterm" and doc["extra"]["who"] == "test"
+
+
+# ---------------------------------------------------------------------------
+# serve integration: spans, drains, rollback, disabled no-op
+# ---------------------------------------------------------------------------
+
+
+def _build_serving(cmds=6, max_seq=128):
+    from fantoch_tpu.core.config import Config
+    from fantoch_tpu.core.planet import Planet
+    from fantoch_tpu.core.workload import KeyGen, Workload
+    from fantoch_tpu.engine import setup
+    from fantoch_tpu.parallel import quantum
+    from fantoch_tpu.protocols import basic as basic_proto
+
+    planet = Planet.new()
+    config = Config(n=3, f=1, gc_interval_ms=100)
+    wl = Workload(1, KeyGen.conflict_pool(50, 2), 1, cmds)
+    pdef = basic_proto.make_protocol(3, 1)
+    spec = setup.build_spec(
+        config, wl, pdef, n_clients=2, n_client_groups=2, extra_ms=1000,
+        max_steps=5_000_000, max_seq=max_seq, open_loop_interval_ms=25,
+    )
+    placement = setup.Placement(
+        ["asia-east1", "us-central1", "us-west1"],
+        ["us-west1", "europe-west2"], 1,
+    )
+    env = setup.build_env(spec, config, planet, placement, wl, pdef)
+    ing = quantum.build_runner(
+        spec, pdef, wl, env,
+        ingress=quantum.IngressSpec(ring_slots=32, mega_k=2,
+                                    batch_max_size=1),
+    )
+    return types.SimpleNamespace(
+        spec=spec, pdef=pdef, wl=wl, env=env, ing=ing,
+        mesh=quantum.make_mesh(3),
+    )
+
+
+@pytest.fixture(scope="module")
+def served():
+    """One shared serving deployment (no trace channels — telemetry is
+    host-side); the compiled serve program is reused by every serve test
+    in this module."""
+    return _build_serving()
+
+
+def test_serve_spans_and_metrics_drains(served, tmp_path):
+    from fantoch_tpu.ingress import ServeRuntime, SyntheticOpenLoopTrace
+
+    reg = T.MetricsRegistry()
+    mpath = tmp_path / "serve.prom"
+    rt = ServeRuntime(
+        served.ing, served.mesh, served.env, window_ms=50,
+        stall_gap_ms=30000, registry=reg, metrics_out=str(mpath),
+        metrics_interval_s=0.0,
+    )
+    feed = SyntheticOpenLoopTrace(clients=6, interval_ms=25,
+                                  commands_per_client=2, key_space=4,
+                                  seed=2)
+    report, _ = rt.run(feed, max_wall_s=600, max_megachunks=400)
+    assert report["aborted"] is None
+    assert report["completed"] == report["issued"] == 12
+    # instrumentation is zero-cost to the device contract
+    assert report["syncs_per_megachunk"] == 1.0
+    # exactly one dispatch span per dispatched megachunk
+    assert reg.counter("spans_total", stage="dispatch").value \
+        == report["megachunks"]
+    # the report's series keep their exact shapes (registry-backed now)
+    assert report["telemetry"]
+    assert all(set(t) == {"sim_ms", "issued", "completed", "steps"}
+               for t in report["telemetry"])
+    assert sum(report["completions_per_window"]) == report["completed"]
+    assert report["completions_window0"] == 0
+    assert isinstance(report["deferred"], int)
+    assert isinstance(report["late_pull"], int)
+    # textfile drain parses and agrees with the report
+    parsed = T.parse_textfile(mpath.read_text())
+    assert parsed['fantoch_spans_total{stage="dispatch"}'] \
+        == report["megachunks"]
+    assert parsed["fantoch_serve_completed"] == report["completed"]
+    assert parsed["fantoch_serve_issued"] == report["issued"]
+    # the serve program's first-call resolve (compile here: cold store)
+    # was recorded in-band by make_serve
+    assert parsed["fantoch_serve_program_first_call_s"] > 0
+    # the jsonl snapshot stream parses, seq-monotone
+    lines = [json.loads(x) for x in
+             open(str(mpath) + ".jsonl").read().splitlines()]
+    assert len(lines) >= 2
+    seqs = [ln["seq"] for ln in lines]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    # every serve stage was span-timed
+    stages = {s["stage"] for s in reg.recent_spans()}
+    assert {"host_batch", "device_put", "dispatch", "account"} <= stages
+
+
+def test_serve_disabled_registry_is_noop(served):
+    from fantoch_tpu.ingress import ServeRuntime, SyntheticOpenLoopTrace
+
+    reg = T.MetricsRegistry(enabled=False)
+    rt = ServeRuntime(served.ing, served.mesh, served.env, window_ms=50,
+                      stall_gap_ms=30000, registry=reg)
+    feed = SyntheticOpenLoopTrace(clients=4, interval_ms=25,
+                                  commands_per_client=1, key_space=4,
+                                  seed=4)
+    report, _ = rt.run(feed, max_wall_s=600, max_megachunks=400)
+    assert report["aborted"] is None
+    assert report["completed"] == report["issued"] == 4
+    # the serve contract is untouched by the no-op fast path
+    assert report["syncs_per_megachunk"] == 1.0
+    # and the disabled registry recorded nothing
+    assert report["telemetry"] == []
+    assert report["completions_per_window"] == []
+    assert reg.recent_spans() == []
+    assert reg.snapshot()["counters"] == {}
+
+
+def test_flight_dump_on_forced_serve_health_error(tmp_path):
+    from fantoch_tpu.ingress import (ServeHealthError, ServeRuntime,
+                                     SyntheticOpenLoopTrace)
+
+    # max_seq=2: the per-coordinator dot budget is exhausted by the third
+    # submit routed to one coordinator — the host admission guard raises
+    # ServeHealthError during the FIRST megachunk's plan, before any
+    # dispatch (so only the init program compiles here)
+    dep = _build_serving(cmds=6, max_seq=2)
+    reg = T.MetricsRegistry()
+    fpath = tmp_path / "flight.json"
+    rt = ServeRuntime(dep.ing, dep.mesh, dep.env, window_ms=50,
+                      registry=reg, flight_path=str(fpath))
+    feed = SyntheticOpenLoopTrace(clients=12, interval_ms=10,
+                                  commands_per_client=1, key_space=4,
+                                  seed=9)
+    with pytest.raises(ServeHealthError, match="dot space"):
+        rt.run(feed, max_wall_s=600, max_megachunks=50)
+    doc = T.load_flight_dump(str(fpath))
+    assert doc["reason"] == "serve_health_error"
+    assert "dot space" in doc["extra"]["error"]
+    aborted_mc = doc["extra"]["megachunk"]
+    stages = [s["stage"] for s in doc["spans"]]
+    assert "host_batch" in stages
+    # abort-rollback semantics: the planned-but-never-dispatched
+    # megachunk's spans are marked rolled_back, and it has no dispatch
+    # span — a post-mortem reader cannot mistake staged work for
+    # dispatched work
+    mc_spans = [s for s in doc["spans"] if s.get("megachunk") == aborted_mc]
+    assert mc_spans, "the aborted megachunk left no spans"
+    for s in mc_spans:
+        assert s["rolled_back"] is True
+        assert s["stage"] != "dispatch"

@@ -278,6 +278,62 @@ def compare_drivers(name, B=64, chunk_steps=None, k=4, cmds=25):
     return out
 
 
+def telemetry_overhead(iters=50_000):
+    """Per-span host cost of the telemetry registry, enabled vs DISABLED.
+
+    The disabled registry is the no-op fast path a production serve can
+    leave compiled in (every ServeRuntime megachunk opens four spans);
+    this measures it instead of asserting it — the number rides the
+    --drivers output so a regression in the null path is visible in the
+    same report the driver costs live in."""
+    from fantoch_tpu import telemetry as T
+
+    out = {}
+    for label, reg in (("enabled", T.MetricsRegistry()),
+                       ("disabled", T.MetricsRegistry(enabled=False))):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            with reg.span("probe"):
+                pass
+        out[f"{label}_ns_per_span"] = round(
+            (time.perf_counter() - t0) / iters * 1e9, 1
+        )
+    print(f"telemetry overhead: {out}", file=sys.stderr, flush=True)
+    return out
+
+
+def persist_driver_profile(res):
+    """Emit the per-driver first-call/warm timings through the telemetry
+    snapshot schema (gauges labeled protocol/driver) and append the
+    snapshot beside the AOT executable store — the per-shape cost record
+    ROADMAP item 4's shape-bucket autotuner consumes (verdicts persist
+    next to the executables they describe). Returns the jsonl path, or
+    None when the store is off (BENCH_AOT=0)."""
+    from fantoch_tpu import telemetry as T
+
+    store = bench._aot_store()
+    if store is None:
+        return None
+    reg = T.MetricsRegistry()
+    for proto, rec in res.items():
+        for driver in ("chunk", "megachunk", "megachunk_trace"):
+            drec = rec.get(driver)
+            if not isinstance(drec, dict):
+                continue
+            for field in ("first_call_s", "warm_dispatch_s", "wall_s",
+                          "events_per_sec", "hlo_lines", "dispatches"):
+                if field in drec:
+                    reg.gauge(f"trip_{field}", protocol=proto,
+                              driver=driver).set(drec[field])
+        for field in ("batch", "chunk_steps", "mega_k"):
+            if field in rec:
+                reg.gauge(f"trip_{field}", protocol=proto).set(rec[field])
+    path = os.path.join(store.root, "trip_profile.jsonl")
+    T.append_snapshot(path, reg, extra={"kind": "trip_profile_drivers"})
+    print(f"driver profile appended -> {path}", file=sys.stderr, flush=True)
+    return path
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("protocols", nargs="*", default=["tempo"])
@@ -299,6 +355,10 @@ def main():
             p: compare_drivers(p, args.batch, args.chunk_steps, args.mega_k,
                                args.cmds)
             for p in protos
+        }
+        res["telemetry"] = {
+            "persisted": persist_driver_profile(res),
+            "overhead": telemetry_overhead(),
         }
     else:
         batches = [int(x) for x in args.batches.split(",")]
