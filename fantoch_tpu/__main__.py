@@ -316,6 +316,22 @@ def cmd_serve(args) -> int:
         registry = MetricsRegistry()
         if flight_out:
             install_sigterm_dump(FlightRecorder(registry, flight_out))
+    # chaos serving (engine/faults.py): the schedule fires on device
+    # under the live feed; the report grows a `failover` block
+    # (p50/p99-through-failover off the lat channel) and the stall alarm
+    # treats scheduled outage windows as recovery-in-progress
+    faults = None
+    if args.crash or args.partition or args.drop_pct or args.dup_pct:
+        from .engine.faults import FaultSchedule
+
+        part = _parse_partition(args.partition)
+        faults = FaultSchedule(
+            crash={p: (at, None if rec < 0 else rec)
+                   for p, at, rec in _parse_crash(args.crash)},
+            partition=part if part else None,
+            drop_pct=args.drop_pct,
+            dup_pct=args.dup_pct,
+        )
     try:
         report = serve_mod.run_serve(
             args.protocol, args.n, args.f,
@@ -343,6 +359,8 @@ def cmd_serve(args) -> int:
             max_wall_s=args.max_wall_s or None,
             max_megachunks=args.max_megachunks or None,
             seed=args.seed,
+            faults=faults,
+            leader_check_ms=args.leader_check or None,
             cache=cache,
             registry=registry,
             metrics_out=args.metrics_out or None,
@@ -614,6 +632,29 @@ def cmd_plot(args) -> int:
     }
     if len(ro_values) > 1:
         made.append(nfr_plot(series, os.path.join(args.out, "nfr.png")))
+    # nemesis grids (fault search keys present): availability + p99
+    # heatmaps over the chaos axes, and the per-scenario recovery
+    # timelines when the sweep recorded traces
+    faulty = [
+        e for e in db
+        if e.search.get("crash") or e.search.get("partition")
+        or e.search.get("drop_pct") or e.search.get("dup_pct")
+    ]
+    if faulty:
+        from .plot.plots import nemesis_heatmap, nemesis_recovery_plot
+
+        made.append(nemesis_heatmap(
+            faulty, os.path.join(args.out, "nemesis_availability.png"),
+            value="availability",
+        ))
+        made.append(nemesis_heatmap(
+            faulty, os.path.join(args.out, "nemesis_p99.png"),
+            value="p99_ms",
+        ))
+        if any(e.traces.get("done") is not None for e in faulty):
+            made.append(nemesis_recovery_plot(
+                faulty, os.path.join(args.out, "nemesis_recovery.png"),
+            ))
     table = dstat_table(args.results)
     if len(table.splitlines()) > 1:
         print(table, file=sys.stderr)
@@ -970,6 +1011,22 @@ def main(argv=None) -> int:
     pv.add_argument("--max-queue", type=int, default=100_000)
     pv.add_argument("--max-wall-s", type=float, default=0.0)
     pv.add_argument("--seed", type=int, default=0)
+    # chaos serving: the sim/trace fault flags, fired under live load
+    pv.add_argument(
+        "--crash", action="append", default=[], metavar="P@T0[:T1]",
+        help="crash process P (0-based) at T0 ms, recover at T1 ms"
+        " (omit T1 for a permanent crash); repeatable",
+    )
+    pv.add_argument("--partition", default="", metavar="A,B,..@T0:T1",
+                    help="partition processes A,B,.. from the rest"
+                    " during [T0, T1) ms")
+    pv.add_argument("--drop-pct", type=int, default=0,
+                    help="deterministic per-message drop percentage")
+    pv.add_argument("--dup-pct", type=int, default=0,
+                    help="deterministic per-message duplication percentage")
+    pv.add_argument("--leader-check", type=int, default=0,
+                    help="leader failure-detection interval ms (leader"
+                    " protocols; required for failover under --crash)")
     pv.add_argument("--aot-cache", action="store_true",
                     help="warm-start the serve program through the"
                          " persistent AOT executable store")

@@ -18,8 +18,10 @@ field:
   protocol link between the `part_a` bitmask group and its complement.
   Messages *emitted* during the window across the cut are lost.
 - **drop/dup**: hash-salted per-message loss/duplication percentages over
-  protocol messages (murmur3-finalizer of the message's engine sequence
-  number — deterministic per run, like the hash-reorder mode).
+  protocol messages (murmur3-finalizer of a content-derived message
+  identity — `(src, dst, kind, logical send index)`, see
+  `message_identity` — deterministic per run AND identical across
+  engines, so a schedule's per-message verdicts are engine-independent).
 
 Failure *detection* is perfect and instantaneous: the schedule is part of
 `Env`, so quorum selection (`dynamic_masks`) can avoid processes that are
@@ -56,6 +58,9 @@ from .types import INF_TIME, KIND_PROTO_BASE, KIND_SUBMIT, bit
 # from each other and from the reorder salt so the three draws decorrelate)
 DROP_SALT = np.uint32(0x5EED0D20)
 DUP_SALT = np.uint32(0xD0B1E5A1)
+# salt distinguishing a duplicated copy's identity from its original (the
+# copy draws its own, independent drop lottery)
+DUP_COPY_SALT = np.uint32(0xDC0B7A11)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -104,6 +109,25 @@ class FaultSchedule:
             or self.drop_pct
             or self.dup_pct
         )
+
+
+def schedule_json(s: FaultSchedule) -> Dict[str, object]:
+    """JSON-stable rendering of a schedule (`exp.harness.Point.search()`'s
+    fault-field shape): serve reports and flight-recorder dumps echo the
+    live schedule through this so post-mortems carry the exact scenario."""
+    return {
+        "crash": [
+            [int(p), int(at), -1 if rec is None else int(rec)]
+            for p, (at, rec) in sorted(s.crash.items())
+        ],
+        "partition": (
+            [[int(p) for p in s.partition[0]],
+             int(s.partition[1]), int(s.partition[2])]
+            if s.partition is not None else []
+        ),
+        "drop_pct": int(s.drop_pct),
+        "dup_pct": int(s.dup_pct),
+    }
 
 
 def no_fault_env_fields(n: int) -> Dict[str, np.ndarray]:
@@ -174,8 +198,44 @@ def lottery_salt(env) -> jnp.ndarray:
     return (env.seed[0] ^ env.seed[1]).astype(jnp.uint32)
 
 
+def _mix(h, v):
+    """One murmur-style sequential mix step folding field `v` into `h`."""
+    h = h ^ jnp.asarray(v).astype(jnp.uint32)
+    h = h * jnp.uint32(0x85EBCA6B)
+    return h ^ (h >> 13)
+
+
+def message_identity(src, dst, kind_idx, send_idx):
+    """Content-derived uint32 identity of one protocol message, identical
+    across the lockstep engine and the quantum runner.
+
+    The identity hashes WHAT the message is, not when an engine happened
+    to enumerate it: `(src, dst, kind_idx, send_idx)` where `kind_idx` is
+    the protocol-level kind (`kind - KIND_PROTO_BASE`, equal to the
+    quantum runner's `kind - RK_PROTO_BASE` by construction) and
+    `send_idx` the logical send index — how many protocol messages this
+    `(src, dst, kind_idx)` channel emitted before this one, counted
+    PRE-loss (a dropped message still consumes its index). Per-source
+    emission order is schedule-independent (the same invariant behind the
+    conservative-lookahead tie keys), so both engines count identically
+    and the drop/dup lotteries draw the same verdict per message."""
+    h = jnp.full(jnp.broadcast_shapes(
+        jnp.shape(src), jnp.shape(dst), jnp.shape(kind_idx),
+        jnp.shape(send_idx)), 0x9E3779B9, jnp.uint32)
+    h = _mix(h, src)
+    h = _mix(h, dst)
+    h = _mix(h, kind_idx)
+    return _mix(h, send_idx)
+
+
+def dup_copy_identity(msg_ids):
+    """Identity of the duplicated COPY of `msg_ids`: a further salted mix,
+    so the copy draws its own independent drop lottery."""
+    return _mix(jnp.asarray(msg_ids).astype(jnp.uint32), DUP_COPY_SALT)
+
+
 def drop_lottery(env, msg_ids) -> jnp.ndarray:
-    """[CN] bool: hash-dropped message? (`msg_ids` = unique engine seqnos)"""
+    """[CN] bool: hash-dropped message? (`msg_ids` = message identities)"""
     return _hash_pct(msg_ids, lottery_salt(env) ^ DROP_SALT) < env.drop_pct
 
 

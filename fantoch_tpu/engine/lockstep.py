@@ -85,7 +85,11 @@ _BIG = jnp.int32(2**30)
 # fingerprints record it (exp/harness.py) so stale buckets from an older
 # contract re-run instead of silently mixing. Pure scheduling changes that
 # the A/B equality suite proves unobservable do NOT bump it.
-ENGINE_CONTRACT = 5  # 5: partition windows feed the perfect failure
+ENGINE_CONTRACT = 6  # 6: drop/dup lotteries hash content-derived message
+# identities (engine-independent; faults.message_identity), fpaxos
+# failover chains to the first ALIVE successor, and deadline-boundary
+# events are clamped identically in both engines.
+# 5: partition windows feed the perfect failure
 # detector (dynamic quorum masks avoid cross-cut peers; engine/faults.py)
 #
 # Engine invariants, by HOW each is enforced (`python -m fantoch_tpu lint`
@@ -324,6 +328,11 @@ class SimState(NamedTuple):
     # SimSpec.trace is set, None otherwise — None is an EMPTY pytree node,
     # so disabled builds carry zero extra leaves)
     trace: Any = None
+    # [n*n*NK] int32 per-(src, dst, proto-kind) logical send counters —
+    # the engine-independent message-identity basis of the drop/dup
+    # lotteries (faults.message_identity); counted PRE-loss, originals
+    # only. None (an empty pytree node) unless SimSpec.faults.
+    send_cnt: Any = None
 
 
 class Candidates(NamedTuple):
@@ -506,6 +515,10 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         and not os.environ.get("FANTOCH_EXACT")
     )
     DTOT = n + C  # global destination/source space: processes then clients
+    # message-identity channel space (spec.faults): one logical send
+    # counter per (src, dst, proto-kind) — see SimState.send_cnt
+    NK = max(1, pdef.n_msg_kinds)
+    NCH = n * n * NK
     NT = NPER - 1  # fast-path timer slots (the trailing cleanup tick is
     # subsumed by the per-trip trailing drain; see _fast_round docstring)
     _HUGE = jnp.int32(2**31 - 1)
@@ -559,28 +572,46 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
     def _insert(st: SimState, env: Env, cand: Candidates) -> SimState:
         if spec.faults:
             # the single fault choke point: every message the simulation
-            # ever sends passes through here. Duplicate first (dup copies
-            # are ordinary candidates arriving 1 ms later, then subject to
-            # the same loss rules), then apply the schedule's losses. The
-            # duplication lottery doubles the candidate array, so it is
-            # gated by its own STATIC flag (SimSpec.faults_dup).
-            # lottery ids: seqno + per-VALID rank (the reorder_hash
-            # discipline below) — unique, consecutive across inserts;
-            # positional ids would collide between inserts since seqno
-            # only advances by the valid count
+            # ever sends passes through here. Lottery ids are the
+            # engine-independent message identities (faults.py): per
+            # (src, dst, proto-kind) channel, the running logical send
+            # index — counted PRE-loss, originals only — hashed with the
+            # message's content fields. The quantum runner computes the
+            # identical ids at its send boundary, so a schedule's
+            # per-message drop/dup verdicts are engine-independent.
+            # Duplicate first (dup copies are ordinary candidates arriving
+            # 1 ms later with their own salted identity, then subject to
+            # the same loss rules); the duplication lottery doubles the
+            # candidate array, so it is gated by its own STATIC flag
+            # (SimSpec.faults_dup).
+            is_proto = cand.valid & (cand.kind >= KIND_PROTO_BASE)
+            kidx = jnp.clip(cand.kind - KIND_PROTO_BASE, 0, NK - 1)
+            ch = jnp.clip(
+                (cand.src * n + jnp.clip(cand.dst, 0, n - 1)) * NK + kidx,
+                0, NCH - 1,
+            )
+            ohc = dense.oh(ch, NCH) & is_proto[:, None]  # [CN, NCH]
+            pref = jnp.cumsum(ohc.astype(jnp.int32), axis=0) - ohc
+            rank = jnp.sum(jnp.where(ohc, pref, 0), axis=1)  # [CN]
+            base_cnt = jnp.sum(
+                jnp.where(ohc, st.send_cnt[None, :], 0), axis=1
+            )
+            msg_id = faults_mod.message_identity(
+                cand.src, cand.dst, kidx, base_cnt + rank
+            )
+            st = st._replace(send_cnt=st.send_cnt + ohc.sum(axis=0))
             if spec.faults_dup:
-                ids0 = st.seqno + jnp.cumsum(cand.valid) - 1
-                dup_sel = (
-                    cand.valid
-                    & (cand.kind >= KIND_PROTO_BASE)
-                    & faults_mod.dup_lottery(env, ids0)
-                )
+                dup_sel = is_proto & faults_mod.dup_lottery(env, msg_id)
                 dup = cand._replace(valid=dup_sel, base=cand.base + 1)
                 cand = _cat_cands([cand, dup])
-            ids1 = st.seqno + jnp.cumsum(cand.valid) - 1
+                ids_all = jnp.concatenate(
+                    [msg_id, faults_mod.dup_copy_identity(msg_id)]
+                )
+            else:
+                ids_all = msg_id
             lost = cand.valid & faults_mod.candidate_drop_mask(
                 env, n, cand.kind, cand.src, cand.dst, cand.when,
-                cand.when + cand.base, ids1,
+                cand.when + cand.base, ids_all,
             )
             cand = cand._replace(valid=cand.valid & ~lost)
             st = st._replace(faulted=st.faulted + lost.sum())
@@ -1950,6 +1981,14 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
         safe = (
             (T < h) & (T < INF) & (T <= st.final_time) & (T <= skew_bound)
         )
+        if spec.deadline_ms is not None:
+            # the deadline bounds the PROCESSED event set exactly: events
+            # at instants past it never act (the trip that would, instead
+            # only advances `now` past the deadline so the loop cond
+            # stops). The quantum runner's `t_next <= deadline` stop draws
+            # the same boundary — deadline-stopped runs stay trace-equal
+            # across engines.
+            safe = safe & (T <= jnp.int32(spec.deadline_ms))
 
         # --- phase: messages before timers, per component ---
         m_at = (evt_msg == T) & (evt_msg < INF)  # [D]
@@ -2044,6 +2083,9 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
                 tmr_bound,
                 jnp.minimum(st.final_time, skew_bound),
             )  # [n]
+            if spec.deadline_ms is not None:
+                # folds honor the deadline boundary too (see `safe` above)
+                tbound = jnp.minimum(tbound, jnp.int32(spec.deadline_ms))
             # submits are never consumed by fold steps (their registration
             # is a pre-pass), so they must BOUND the fold instead: folding
             # past a pending submit's (time, tie) would advance lc beyond
@@ -2319,6 +2361,9 @@ def make_engine(spec: SimSpec, pdef: ProtocolDef, wl: workload_mod.Workload):
             olog_len=jnp.zeros((n,), jnp.int32),
             proto=pdef.init(spec, env),
             exec=exdef.init(spec, env),
+            send_cnt=(
+                jnp.zeros((NCH,), jnp.int32) if spec.faults else None
+            ),
         )
         if spec.reorder and not OPEN:
             # apply the reorder multiplier to the initial submits too

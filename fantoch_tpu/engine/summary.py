@@ -185,6 +185,48 @@ def recovery_stats(st: SimState, env: Env) -> Dict[str, float]:
     }
 
 
+def grid_recovery_stats(st: SimState) -> Dict[str, np.ndarray]:
+    """`recovery_stats` over a BATCHED SimState (a vmapped nemesis grid,
+    `engine/sweep.stack_nemesis`): per-scenario `[B]` arrays —
+
+    - `completed`: commands with a recorded completion instant (closed
+      loops reuse slots, so this lower-bounds the true count);
+    - `availability`: completions (`lat_cnt`) / issued (1.0 = every
+      issued command came back despite the scenario's faults; a > f
+      crash shows as < 1);
+    - `max_gap_ms`: the longest completion silence (crash-to-failover);
+    - `last_completion_ms`: when the scenario's workload finished;
+    - `all_done`: the engine's own completion flag.
+
+    The scalar rows behind the availability/recovery heatmaps
+    (`plot.plots.nemesis_heatmap`): one figure cell per scenario."""
+    done = np.asarray(st.c_done_ms)  # [B, C, CT]
+    issued = np.asarray(st.c_issued)  # [B, C]
+    B = done.shape[0]
+    completed = np.zeros((B,), np.int64)
+    max_gap = np.zeros((B,), np.float64)
+    last = np.zeros((B,), np.float64)
+    for b in range(B):
+        row = done[b].ravel()
+        times = np.sort(row[row > 0])
+        completed[b] = len(times)
+        if len(times):
+            max_gap[b] = float(
+                np.diff(np.concatenate([[0], times])).max()
+            )
+            last[b] = float(times[-1])
+    lat_cnt = np.asarray(st.lat_cnt)  # [B, C]
+    return {
+        "completed": completed,
+        "availability": (
+            lat_cnt.sum(axis=1) / np.maximum(issued.sum(axis=1), 1)
+        ),
+        "max_gap_ms": max_gap,
+        "last_completion_ms": last,
+        "all_done": np.asarray(st.all_done),
+    }
+
+
 def protocol_metrics(st: SimState, pdef: ProtocolDef) -> Dict[str, np.ndarray]:
     if pdef.metrics is None:
         return {}

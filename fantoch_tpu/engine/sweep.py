@@ -31,6 +31,36 @@ def stack_envs(envs: List[Env]) -> Env:
     return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *envs)
 
 
+def stack_nemesis(env: Env, schedules: List[Any]) -> Env:
+    """Lift a nemesis grid onto the sweep batch axis: one base `Env`
+    broadcast across `[B]` fault schedules (`engine/faults.FaultSchedule`,
+    e.g. from `mc.enumerate_nemesis_schedules`).
+
+    Every batch row is the SAME configuration — planet, workload, seed —
+    differing only in the fault fields a schedule lowers to
+    (`FaultSchedule.env_fields`: crash/recover instants, the partition
+    window, drop/dup percentages). The result feeds `run_batch` /
+    `make_megachunk_runner` unchanged, so thousands of crash × partition
+    × lottery scenarios run in ONE device call. The base spec must be
+    built with `faults=True` (and `faults_dup=True` when any schedule
+    duplicates) — those are compile-time gates, not Env data."""
+    B = len(schedules)
+    assert B > 0, "empty nemesis grid"
+    assert env.crash_at is not None, (
+        "stack_nemesis needs a fault-enabled Env: build the spec with "
+        "faults=True so build_env lowers the fault fields"
+    )
+    n = int(np.asarray(env.crash_at).shape[0])
+    batched = jax.tree_util.tree_map(
+        lambda x: np.stack([np.asarray(x)] * B), env
+    )
+    fields = [s.env_fields(n) for s in schedules]
+    return batched._replace(**{
+        k: np.stack([np.asarray(f[k]) for f in fields])
+        for k in fields[0]
+    })
+
+
 def run_batch(spec: SimSpec, pdef: ProtocolDef, wl: Workload, batched_env: Env) -> SimState:
     """vmap the whole simulation over the config axis (single device)."""
     run = make_run(spec, pdef, wl)

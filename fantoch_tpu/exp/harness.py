@@ -215,6 +215,32 @@ def make_protocol_def(
     raise ValueError(f"unknown protocol {name!r}; have {PROTOCOLS}")
 
 
+def nemesis_points(base: Point, schedules) -> List[Point]:
+    """Map a nemesis grid (`engine/faults.FaultSchedule`s, e.g. from
+    `mc.enumerate_nemesis_schedules`) onto grid points: each schedule
+    becomes `base` with the fault fields replaced. All points share
+    `base`'s shape knobs, so `run_grid` batches the whole grid into ONE
+    device call per compile bucket (`_bucket_key` keys fault PRESENCE,
+    not the schedule — the schedule itself is Env data; only mixing
+    dup_pct == 0 with > 0, or different deadlines, splits buckets)."""
+    out = []
+    for s in schedules:
+        crash = tuple(sorted(
+            (int(p), int(at), -1 if rec is None else int(rec))
+            for p, (at, rec) in s.crash.items()
+        ))
+        part = (
+            (tuple(int(p) for p in s.partition[0]),
+             int(s.partition[1]), int(s.partition[2]))
+            if s.partition is not None else ()
+        )
+        out.append(dataclasses.replace(
+            base, crash=crash, partition=part,
+            drop_pct=int(s.drop_pct), dup_pct=int(s.dup_pct),
+        ))
+    return out
+
+
 def _bucket_key(pt: Point) -> Tuple:
     return (
         pt.protocol,

@@ -54,6 +54,7 @@ def build_serving(
     trace_window_ms: int = 100,
     trace_windows: int = 256,
     faults=None,
+    leader_check_ms: Optional[int] = None,
     seed: int = 0,
 ):
     """(runner, mesh, spec, env, pdef, wl, tspec) for one serving config.
@@ -86,7 +87,8 @@ def build_serving(
         max_seq=max_commands, key_space_hint=wl.key_space(C),
     )
     leader = 1 if not pdef.leaderless else None
-    config = Config(n=n, f=f, gc_interval_ms=gc_interval_ms, leader=leader)
+    config = Config(n=n, f=f, gc_interval_ms=gc_interval_ms, leader=leader,
+                    leader_check_interval_ms=leader_check_ms)
     tspec = trace
     if tspec is None:
         tspec = TraceSpec(
@@ -105,6 +107,7 @@ def build_serving(
         batch_max_delay_ms=batch_delay_ms,
         pool_slots=pool_slots,
         faults=faults is not None,
+        faults_dup=faults is not None and bool(faults.dup_pct),
         trace=tspec,
     )
     if batch > 1:
@@ -144,6 +147,47 @@ def drain_serve_trace(st, tspec: TraceSpec) -> Dict[str, Any]:
     return out
 
 
+def failover_report(st, tspec: TraceSpec, faults) -> Dict[str, Any]:
+    """SLO-through-failover view of one chaos serve: the schedule echo
+    plus — when a crash is scheduled and the lat/done channels were
+    traced — the p50/p99 of every completion AT OR AFTER the first crash
+    instant (the latencies a client actually saw through the failover
+    window, detection timeout and recovery rounds included) and the
+    outage/recovery edge off the per-window completion series."""
+    from ..engine import faults as faults_mod
+    from ..obs import report as obs_report
+
+    out: Dict[str, Any] = {"schedule": faults_mod.schedule_json(faults)}
+    tr = getattr(st, "trace", None)
+    if tr is None or not faults.crash:
+        return out
+    wm = tspec.window_ms
+    crash_ms = min(at for at, _rec in faults.crash.values())
+    w0 = max(0, int(crash_ms) // wm)
+    out["crash_ms"] = int(crash_ms)
+    if "lat" in tr:
+        lat = np.asarray(tr["lat"]).sum(axis=0)  # [W, G, LB]
+        p = obs_report.lat_percentiles(lat[w0:], wm)["overall"]
+        out["through_failover"] = {
+            "count": p["count"],
+            "p50_ms": p["p50_ms"],
+            "p99_ms": p["p99_ms"],
+        }
+    if "done" in tr:
+        done = np.asarray(tr["done"]).sum(axis=0).sum(axis=1)  # [W]
+        nz = np.nonzero(done[w0:] > 0)[0]
+        # completions in the crash window itself count as instant
+        # recovery (outage_windows == 0); a fully dark tail means the
+        # failover never landed (recovered_ms is None — the > f case)
+        out["outage_windows"] = (
+            int(nz[0]) if len(nz) else int(done[w0:].shape[0])
+        )
+        out["recovered_ms"] = (
+            int((w0 + int(nz[0])) * wm) if len(nz) else None
+        )
+    return out
+
+
 def run_serve(
     protocol: str = "basic",
     n: int = 3,
@@ -178,6 +222,7 @@ def run_serve(
     max_megachunks: Optional[int] = None,
     seed: int = 0,
     faults=None,
+    leader_check_ms: Optional[int] = None,
     cache=None,
     # host telemetry (fantoch_tpu/telemetry): registry for spans/series,
     # Prometheus textfile (+ .jsonl snapshot stream) on an interval, and
@@ -226,6 +271,7 @@ def run_serve(
         trace_window_ms=window_ms,
         trace_windows=trace_windows,
         faults=faults,
+        leader_check_ms=leader_check_ms,
         seed=seed,
     )
     rt = ServeRuntime(
@@ -239,6 +285,7 @@ def run_serve(
         metrics_out=metrics_out,
         metrics_interval_s=metrics_interval_s,
         flight_path=flight_path,
+        faults=faults,
     )
     report, st = rt.run(feed, max_wall_s=max_wall_s,
                         max_megachunks=max_megachunks)
@@ -253,6 +300,8 @@ def run_serve(
             "spans_total", stage="dispatch"
         ).value
     report.update(drain_serve_trace(st, tspec))
+    if faults is not None:
+        report["failover"] = failover_report(st, tspec, faults)
     if cache is not None:
         report["cache"] = cache.stats()
     return report

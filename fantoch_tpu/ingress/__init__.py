@@ -10,7 +10,11 @@ double-buffered serving loop (`runtime.py`). Harness entry:
 """
 from ..parallel.quantum import IngressSpec, Pulse, Ring  # noqa: F401
 from .batcher import HostBatcher, MergedCmd  # noqa: F401
-from .runtime import ServeHealthError, ServeRuntime  # noqa: F401
+from .runtime import (  # noqa: F401
+    ServeHealthError,
+    ServeRuntime,
+    fault_quiet_ms,
+)
 from .stream import (  # noqa: F401
     SyntheticOpenLoopTrace,
     TraceBatch,

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..engine.faults import schedule_json as fault_schedule_json
 from ..telemetry import FlightRecorder, MetricsRegistry, TextfileExporter
 from .batcher import HostBatcher, MergedCmd
 from .stream import TraceBatch
@@ -47,6 +48,22 @@ _SEQ_BASE = 1 << 22  # injected tie-keys sort after protocol traffic
 class ServeHealthError(RuntimeError):
     """A device-side capacity contract broke mid-serve (pool/inbox
     overflow): results would be silently wrong, so the serve aborts."""
+
+
+def fault_quiet_ms(faults) -> int:
+    """The instant every SCHEDULED outage of `faults` has healed: the max
+    over finite crash recoveries and the partition's `until`. Permanent
+    crashes (`recover=None`) contribute NOTHING — a > f permanent crash
+    must still trip the stall abort, while silence before this instant is
+    recovery-in-progress, not a stall."""
+    quiet = 0
+    if faults is not None:
+        for _p, (_at, rec) in faults.crash.items():
+            if rec is not None:
+                quiet = max(quiet, int(rec))
+        if faults.partition is not None:
+            quiet = max(quiet, int(faults.partition[2]))
+    return int(quiet)
 
 
 class ServeRuntime:
@@ -79,7 +96,8 @@ class ServeRuntime:
                  registry: Optional[MetricsRegistry] = None,
                  metrics_out: Optional[str] = None,
                  metrics_interval_s: float = 10.0,
-                 flight_path: Optional[str] = None):
+                 flight_path: Optional[str] = None,
+                 faults=None):
         assert overflow in ("defer", "drop"), overflow
         assert runner.ingress is not None, (
             "build the runner with ingress=IngressSpec(...)"
@@ -183,6 +201,12 @@ class ServeRuntime:
         # needs — the per-window series below is report telemetry only
         # and stays bounded
         self._last_progress_ms = 0
+        # chaos serving: the schedule the env was lowered with (crashes /
+        # partitions / lotteries fire ON DEVICE; the host only needs it
+        # to tell recovery-in-progress from a real stall — see
+        # fault_quiet_ms and _stalled)
+        self.faults = faults
+        self._fault_quiet_ms = fault_quiet_ms(faults)
         # feed time-origin rebase (set on the first pulled command when
         # its issue instant is far from 0 — e.g. an epoch-ms socket
         # feed): the sim clock always starts at 0, so without a rebase
@@ -461,8 +485,14 @@ class ServeRuntime:
         # scalar form (silence since the last completion while the clock
         # kept advancing), with the progress reference so an idle feed
         # span (nothing outstanding, clock advancing on timers) never
-        # reads as a stall once work resumes
-        gap = float(self.sim_now - self._last_progress_ms)
+        # reads as a stall once work resumes. With a fault schedule, the
+        # reference also floors at the schedule's quiet instant: silence
+        # inside a scheduled outage window (crash not yet recovered,
+        # partition not yet healed) is recovery-in-progress, not a stall
+        # — the gap only starts counting once the schedule says the
+        # cluster is whole again. Permanent crashes get no such floor.
+        ref = max(self._last_progress_ms, self._fault_quiet_ms)
+        gap = float(self.sim_now - ref)
         return gap if gap > self.stall_gap_ms else None
 
     def _rollback(self, pre_plan, idx: int) -> None:
@@ -537,11 +567,17 @@ class ServeRuntime:
                         aborted = "stall"
                         self._rollback(pre_plan, idx)
                         if self._flight is not None:
-                            self._flight.dump(
-                                "stall_abort",
-                                extra={"stall_gap_ms": stall_gap,
-                                       "megachunk": idx},
-                            )
+                            extra = {"stall_gap_ms": stall_gap,
+                                     "megachunk": idx}
+                            if self.faults is not None:
+                                # post-mortem context: the schedule that
+                                # was live when the serve wedged (a > f
+                                # permanent crash reads straight off it)
+                                extra["fault_schedule"] = \
+                                    fault_schedule_json(self.faults)
+                                extra["fault_quiet_ms"] = \
+                                    self._fault_quiet_ms
+                            self._flight.dump("stall_abort", extra=extra)
                         break
                 if self._complete():
                     # post-completion drain: keep the horizons advancing
@@ -628,4 +664,7 @@ class ServeRuntime:
             "feed_t_shift_ms": self._t_shift or 0,
             "telemetry": self._tele.list()[-64:],
         }
+        if self.faults is not None:
+            report["fault_schedule"] = fault_schedule_json(self.faults)
+            report["fault_quiet_ms"] = self._fault_quiet_ms
         return report, st

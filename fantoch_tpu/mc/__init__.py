@@ -1,1 +1,6 @@
-from .checker import SynodModel, check_agreement  # noqa: F401
+from .checker import (  # noqa: F401
+    SynodModel,
+    check_agreement,
+    enumerate_crash_schedules,
+    enumerate_nemesis_schedules,
+)

@@ -307,3 +307,66 @@ def enumerate_crash_schedules(
                 m, crashed=frozenset(subset)
             )
     return out
+
+
+def enumerate_nemesis_schedules(
+    n: int = 3,
+    f: int = 1,
+    *,
+    max_crashes: Optional[int] = None,
+    crash_times: Tuple[int, ...] = (100,),
+    recover_after_ms: Optional[int] = None,
+    partitions: Tuple[Optional[Tuple[Tuple[int, ...], int, int]], ...] = (
+        None,
+    ),
+    drop_pcts: Tuple[int, ...] = (0,),
+    dup_pcts: Tuple[int, ...] = (0,),
+) -> List["faults_mod.FaultSchedule"]:
+    """The full nemesis matrix as concrete `FaultSchedule`s — the grid
+    generator feeding the vmapped sweep (`engine/sweep.stack_nemesis`,
+    `exp/harness.nemesis_points`).
+
+    Cartesian product over every axis: crash subsets of up to
+    `max_crashes` (default f) processes started at each of `crash_times`
+    (recovering `recover_after_ms` later, or never when None), one
+    optional partition window per entry in `partitions` (None = no
+    partition), and the drop/dup lottery percentages. Deduplicated by
+    *effective* `Env` fields (`FaultSchedule.env_fields`): e.g. the empty
+    crash subset collapses every crash-time variant into one schedule, so
+    the emitted list is exactly the distinct fault programs.
+
+    `enumerate_crash_schedules` above model-checks the crash axis
+    exhaustively; this enumerator aims the same subsets (plus the
+    partition and lottery axes the checker's message-set network model
+    already subsumes) at the simulation engines, where trace timelines
+    and availability heatmaps quantify what the checker only proves safe.
+    """
+    from ..engine import faults as faults_mod
+
+    max_crashes = f if max_crashes is None else max_crashes
+    out: List[faults_mod.FaultSchedule] = []
+    seen = set()
+    for k in range(max_crashes + 1):
+        for subset in itertools.combinations(range(n), k):
+            for at in crash_times:
+                rec = (
+                    None if recover_after_ms is None
+                    else int(at) + int(recover_after_ms)
+                )
+                crash = {p: (int(at), rec) for p in subset}
+                for part in partitions:
+                    for drop in drop_pcts:
+                        for dup in dup_pcts:
+                            s = faults_mod.FaultSchedule(
+                                crash=crash, partition=part,
+                                drop_pct=int(drop), dup_pct=int(dup),
+                            )
+                            key = tuple(sorted(
+                                (name, np.asarray(v).tobytes())
+                                for name, v in s.env_fields(n).items()
+                            ))
+                            if key in seen:
+                                continue
+                            seen.add(key)
+                            out.append(s)
+    return out

@@ -260,6 +260,11 @@ class RState(NamedTuple):
     # (cleared at inject, set at completion — the host's sliding-window
     # admission reads it off the Pulse)
     inj_drop: Any = None  # [n] ring rows refused by a full inbox
+    # [n, n, NK] int32 per-(dst, proto-kind) logical send counters of THIS
+    # device as src — the engine-independent message-identity basis of the
+    # drop/dup lotteries (faults.message_identity); counted PRE-loss,
+    # originals only. None (an empty pytree node) unless SimSpec.faults.
+    send_cnt: Any = None
 
 
 class Local(NamedTuple):
@@ -302,18 +307,11 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             " engine (engine/lockstep.py) keeps the in-engine batching"
             " mode."
         )
-    if spec.faults:
-        # crash + partition schedules are deterministic functions of TIME,
-        # so lockstep and the runner stay observation-equal under them; the
-        # drop/dup lotteries hash the ENGINE's message seqnos, which differ
-        # between the two engines by construction — event-engine only
-        assert int(np.asarray(env.drop_pct)) == 0 and int(
-            np.asarray(env.dup_pct)
-        ) == 0, (
-            "hash drop/dup lotteries are an event-engine mode (per-message"
-            " ids differ across engines); the runner supports crash and"
-            " partition schedules"
-        )
+    # The full fault schedule is supported: crash + partition are
+    # deterministic functions of TIME, and the drop/dup lotteries hash
+    # content-derived message identities (faults.message_identity — per
+    # (src, dst, kind) logical send indices, identical across engines), so
+    # lockstep and the runner stay observation-equal under any schedule.
     ING = ingress is not None
     if ING and spec.open_loop_interval_ms is None:
         raise ValueError(
@@ -346,8 +344,12 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         # in-flight protocol traffic (inject refuses past capacity and
         # counts inj_drop, which the serve runtime treats as fatal)
         IP = max(IP, 2 * R_ING * K_ING)
+    # message-identity channel space (spec.faults): one logical send
+    # counter per (dst, proto-kind) on each src device — see RState.send_cnt
+    NK = max(1, pdef.n_msg_kinds)
     # worst-case send rows appended per handled event to one dst column
-    WC = pdef.max_out + 2 + spec.max_res
+    # (each outbox row may add its dup copy under SimSpec.faults_dup)
+    WC = (2 if spec.faults_dup else 1) * pdef.max_out + 2 + spec.max_res
     SB = send_slots or max(8 * WC, 64)
     assert SB >= 2 * WC
 
@@ -579,6 +581,9 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             c_bcount=jnp.zeros((n, CM, CT), jnp.int32) if ING else None,
             c_fin=jnp.zeros((n, CM, CT), jnp.int32) if ING else None,
             inj_drop=jnp.zeros((n,), jnp.int32) if ING else None,
+            send_cnt=(
+                jnp.zeros((n, n, NK), jnp.int32) if spec.faults else None
+            ),
         )
 
     # ------------- device-side helpers (local leading axis = 1) -------------
@@ -615,6 +620,16 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             wq_row = lenv.wq_mask[myrow][None]
             maj_row = lenv.maj_mask[myrow][None]
         return Env(
+            # the fault schedule stays GLOBAL [n] (handlers probe other
+            # processes' windows — e.g. fpaxos' first-alive-successor
+            # candidate selection), exactly the lockstep handler view
+            crash_at=F_CRASH,
+            recover_at=F_REC,
+            part_a=F_PART_A,
+            part_from=F_PART_FROM,
+            part_until=F_PART_UNTIL,
+            drop_pct=jnp.asarray(env.drop_pct),
+            dup_pct=jnp.asarray(env.dup_pct),
             dist_pp=lenv.dist_pp[myrow][None, :],
             dist_pc=lenv.dist_pc[myrow][None, :],
             dist_cp=lenv.cl_dist_cp[myrow][:, 0][:, None],
@@ -717,7 +732,8 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         )
 
     def send_broadcast(
-        L: Local, myrow, tgt_mask, kind, payload, enable, zero_delay=False
+        L: Local, myrow, tgt_mask, kind, payload, enable, zero_delay=False,
+        proto=False,
     ) -> Local:
         """Vectorized push of one message row to every process in `tgt_mask`.
 
@@ -731,6 +747,15 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         emission instant (the lockstep engine's shared command table):
         delivery at `now`, before any same-instant protocol message
         (`deliverables` orders command records first).
+
+        `proto` (STATIC, set only by `send_outbox`) marks protocol
+        messages: under `spec.faults` they run the drop/dup lotteries over
+        their engine-independent identities (faults.message_identity) —
+        the lockstep `_insert` fault choke point restated at this send
+        boundary. A dup copy is a second row to the same destination
+        arriving 1 ms later, sharing the original's `seq` (it never ties
+        with a same-instant original, and cross-quantum ties resolve by
+        the emission-ordered seq exactly as the lockstep pool's do).
         """
         dsts = jnp.arange(n, dtype=jnp.int32)
         en = enable & (bit(tgt_mask, dsts) == 1)  # [n]
@@ -739,6 +764,7 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             if zero_delay
             else L.st.now + lenv.dist_pp[myrow]
         )
+        dup_en = None
         if spec.faults:
             # the engine's pool-insert loss rules at the send boundary:
             # crash windows lose arriving process-plane traffic; the
@@ -753,6 +779,40 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             )
             part_lost = (kind >= RK_PROTO_BASE) & in_part & across
             lost = en & (crash_lost | part_lost)
+            if proto:
+                # message identities: per-(dst, kind) logical send index,
+                # counted PRE-loss (a dropped message still consumes its
+                # index) — bit-identical to the lockstep engine's counting
+                kidx = jnp.clip(kind - RK_PROTO_BASE, 0, NK - 1)
+                ohk = (jnp.arange(NK, dtype=jnp.int32) == kidx)  # [NK]
+                base = jnp.sum(
+                    jnp.where(ohk[None, :], L.st.send_cnt[0], 0), axis=1
+                )  # [n]
+                ids = faults_mod.message_identity(myrow, dsts, kidx, base)
+                L = L._replace(st=L.st._replace(
+                    send_cnt=L.st.send_cnt.at[0].add(
+                        (en[:, None] & ohk[None, :]).astype(jnp.int32)
+                    )
+                ))
+                lost = lost | (en & faults_mod.drop_lottery(genv, ids))
+                if spec.faults_dup:
+                    # the copy is selected on the ORIGINAL's identity and
+                    # draws its own losses on its salted copy identity:
+                    # crash at its +1 ms arrival, the partition window at
+                    # the shared emission instant, its own drop lottery
+                    # (a lost copy counts `faulted` apart from its
+                    # original — two candidates, two verdicts)
+                    cids = faults_mod.dup_copy_identity(ids)
+                    dup_sel = en & faults_mod.dup_lottery(genv, ids)
+                    c_crash = (time + 1 >= F_CRASH) & (time + 1 < F_REC)
+                    c_lost = dup_sel & (
+                        c_crash | (in_part & across)
+                        | faults_mod.drop_lottery(genv, cids)
+                    )
+                    dup_en = dup_sel & ~c_lost
+                    L = L._replace(st=L.st._replace(
+                        faulted=L.st.faulted.at[0].add(c_lost.sum())
+                    ))
             L = L._replace(
                 st=L.st._replace(
                     faulted=L.st.faulted.at[0].add(lost.sum())
@@ -763,7 +823,7 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         ok = en & (slot < SB)
         tgt = jnp.where(ok, slot, SB)
         seq = L.st.send_seq[0]
-        return L._replace(
+        L = L._replace(
             s_valid=L.s_valid.at[dsts, tgt].set(True, mode="drop"),
             s_time=L.s_time.at[dsts, tgt].set(time, mode="drop"),
             s_seq=L.s_seq.at[dsts, tgt].set(seq, mode="drop"),
@@ -775,6 +835,26 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
                 dropped=L.st.dropped.at[0].add((en & ~ok).sum()),
             ),
         )
+        if dup_en is not None:
+            # second scatter block: the surviving dup copies, one extra row
+            # per destination column at the slot after the original's
+            slot2 = L.s_cnt
+            ok2 = dup_en & (slot2 < SB)
+            tgt2 = jnp.where(ok2, slot2, SB)
+            L = L._replace(
+                s_valid=L.s_valid.at[dsts, tgt2].set(True, mode="drop"),
+                s_time=L.s_time.at[dsts, tgt2].set(time + 1, mode="drop"),
+                s_seq=L.s_seq.at[dsts, tgt2].set(seq, mode="drop"),
+                s_kind=L.s_kind.at[dsts, tgt2].set(kind, mode="drop"),
+                s_payload=L.s_payload.at[dsts, tgt2].set(
+                    payload[None, :], mode="drop"
+                ),
+                s_cnt=L.s_cnt + ok2.astype(jnp.int32),
+                st=L.st._replace(
+                    dropped=L.st.dropped.at[0].add((dup_en & ~ok2).sum()),
+                ),
+            )
+        return L
 
     def send_outbox(L: Local, myrow, outbox) -> Local:
         rows = outbox.valid.shape[0]
@@ -786,7 +866,7 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
                 )
             L = send_broadcast(
                 L, myrow, outbox.tgt_mask[r], RK_PROTO_BASE + outbox.kind[r],
-                opay, outbox.valid[r],
+                opay, outbox.valid[r], proto=True,
             )
         return L
 
@@ -837,6 +917,18 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
             i_valid=st.i_valid.at[0, slot].set(False),
             step=st.step.at[0].add(1),
         )
+        if TR is not None and st.trace is not None and "deliver" in st.trace:
+            # process-destined deliveries only (submits + protocol
+            # messages), binned at the handling instant — the lockstep
+            # `_delivery_round` has_p rule; client-plane and runner-only
+            # transport kinds (replies, ticks, RK_CMD, RK_PARTIAL) are
+            # excluded exactly as there
+            is_pd = (kind == RK_SUBMIT) | (kind >= RK_PROTO_BASE)
+            ts = dict(st.trace)
+            ts["deliver"] = ts["deliver"].at[0, TR.window_of(st.now)].add(
+                is_pd.astype(jnp.int32)
+            )
+            st = st._replace(trace=ts)
         L = L._replace(st=st)
 
         def b_submit(L):
@@ -1173,9 +1265,14 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         )
         if TR is not None and st.trace is not None and "insert" in st.trace:
             # the runner's send boundary: every exchanged message lands
-            # here — bin accepted arrivals by their delivery instant
+            # here — bin accepted arrivals by their delivery instant.
+            # RK_CMD / RK_PARTIAL are runner-only transport (the lockstep
+            # engine's global command table and in-place partial counting):
+            # excluded, so the channel equals the lockstep pool's inserts
+            rkind = skind.reshape(-1)
+            real = ok & (rkind != RK_CMD) & (rkind != RK_PARTIAL)
             ins0 = obs_trace.wadd_flat(
-                st.trace["insert"][0], TR.window_of(stime.reshape(-1)), ok
+                st.trace["insert"][0], TR.window_of(stime.reshape(-1)), real
             )
             st = st._replace(trace={**st.trace, "insert": ins0[None]})
         return Local(st, *empty_send(), cont=L.cont)
@@ -1350,7 +1447,6 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
         pre_commit = getattr(st.proto, "commit_count", None)
         pre = {
             "submit": st.next_seq[0],
-            "deliver": st.step[0],
             "commit": pre_commit[0] if pre_commit is not None else None,
             "issued": st.c_issued[0],
             "done": st.lat_cnt[0],
@@ -1368,8 +1464,8 @@ def build_runner(spec: SimSpec, pdef: ProtocolDef, wl, env: Env,
 
         if "submit" in ts:
             addw("submit", st2.next_seq[0])
-        if "deliver" in ts:
-            addw("deliver", st2.step[0])
+        # ("deliver" is recorded inside handle_one — per-kind filtering
+        # the step-counter diff cannot express)
         if "commit" in ts and pre["commit"] is not None:
             addw("commit", st2.proto.commit_count[0])
         grp = lenv.cl_group[myrow]  # [CM]

@@ -22,6 +22,10 @@ matplotlib — same families:
 - `host_overhead_timeline` — serve-loop stage time (host batch/staging vs
   device wait) from a telemetry snapshot stream (fantoch_tpu/telemetry)
 - `heatmap_plot`        — metric over a 2-D config grid (`heatmap_plot`)
+- `nemesis_heatmap`     — availability / p99 over two nemesis axes
+  (crash-time × drop-pct) from a vmapped nemesis grid's results
+- `nemesis_recovery_plot` — per-scenario completion timelines from a
+  trace-enabled nemesis sweep (the grid view of `recovery_plot`)
 - `batching_plot`       — throughput/latency vs batch size (`batching_plot`)
 - `metrics_table`       — text table of per-process protocol/executor
   metrics (`process_metrics_table`)
@@ -33,7 +37,7 @@ Figures are written to file (Agg backend); every function returns the path.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -212,6 +216,109 @@ def heatmap_plot(
     fig.savefig(output, bbox_inches="tight", dpi=150)
     plt.close(fig)
     return output
+
+
+def _nemesis_axis(e: ExperimentData, key: str):
+    """Scalar nemesis axis value of one grid entry: plain search keys
+    (drop_pct/dup_pct/...) read directly; the derived keys flatten the
+    fault tuples — `crash_ms` = first crash instant (0 = no crash),
+    `crashes` = number of crashed processes, `partition_ms` = partition
+    start (0 = none)."""
+    if key == "crash_ms":
+        crash = e.search.get("crash") or []
+        return int(crash[0][1]) if crash else 0
+    if key == "crashes":
+        return len(e.search.get("crash") or [])
+    if key == "partition_ms":
+        part = e.search.get("partition") or []
+        return int(part[1]) if part else 0
+    return e.search[key]
+
+
+def _nemesis_value(e: ExperimentData, value: str) -> float:
+    if value == "availability":
+        issued = max(int(e.issued_commands), 1)
+        return float(e.global_latency.count()) / issued
+    if value == "p99_ms":
+        p = e.global_latency.percentile(0.99)
+        return float("nan") if p is None else float(p)
+    raise ValueError(f"unknown nemesis heatmap value {value!r}")
+
+
+def nemesis_heatmap(
+    entries: Sequence[ExperimentData],
+    output: str,
+    x_key: str = "drop_pct",
+    y_key: str = "crash_ms",
+    value: str = "availability",
+) -> str:
+    """`heatmap_plot` adapter over a nemesis grid's results (`run_grid`
+    over `exp/harness.nemesis_points`, or any sweep whose points carry
+    fault fields): availability or p99 over two scalar nemesis axes
+    (drop-pct × crash-time by default). The fault tuples in the search
+    keys are flattened to scalars by `_nemesis_axis`; scenarios sharing
+    an (x, y) cell average (e.g. different crash VICTIMS at one crash
+    instant)."""
+    cells: Dict[Tuple, List[float]] = {}
+    for e in entries:
+        k = (_nemesis_axis(e, x_key), _nemesis_axis(e, y_key))
+        cells.setdefault(k, []).append(_nemesis_value(e, value))
+    xs = sorted({k[0] for k in cells})
+    ys = sorted({k[1] for k in cells})
+    grid = np.full((len(ys), len(xs)), np.nan)
+    for (x, y), vals in cells.items():
+        vals = [v for v in vals if not np.isnan(v)]
+        if vals:
+            grid[ys.index(y), xs.index(x)] = float(np.mean(vals))
+    fig, ax = plt.subplots(figsize=(6, 4))
+    im = ax.imshow(grid, origin="lower", aspect="auto", cmap="viridis")
+    ax.set_xticks(range(len(xs)))
+    ax.set_xticklabels(xs, fontsize=7)
+    ax.set_yticks(range(len(ys)))
+    ax.set_yticklabels(ys, fontsize=7)
+    ax.set_xlabel(x_key)
+    ax.set_ylabel(y_key)
+    label = {"availability": "availability (completed / issued)",
+             "p99_ms": "p99 latency (ms)"}[value]
+    fig.colorbar(im, label=label)
+    fig.savefig(output, bbox_inches="tight", dpi=150)
+    plt.close(fig)
+    return output
+
+
+def nemesis_recovery_plot(
+    entries: Sequence[ExperimentData],
+    output: str,
+    channel: str = "done",
+    window_ms: int = 50,
+    label_keys: Optional[Sequence[str]] = None,
+) -> str:
+    """`recovery_plot` adapter over trace-enabled grid results: each
+    scenario's per-window `channel` timeline (completions per window by
+    default) becomes one site panel, so a crash dip and its failover
+    recovery edge line up across the grid. Entries without trace arrays
+    (the sweep ran without a TraceSpec) are skipped."""
+    keys = label_keys or ["crash", "partition", "drop_pct", "dup_pct"]
+    sites: Dict[str, Dict[str, Sequence[float]]] = {}
+    for e in entries:
+        tr = e.traces.get(channel)
+        if tr is None:
+            continue
+        tr = np.asarray(tr)
+        series = tr if tr.ndim == 1 else tr.reshape(tr.shape[0], -1).sum(
+            axis=1
+        )
+        sites[_label(e, keys)] = {channel: series.tolist()}
+    if not sites:
+        raise ValueError(
+            f"no entries carry a {channel!r} trace — run the sweep with "
+            "a TraceSpec"
+        )
+    return recovery_plot(
+        sites, output,
+        x_label=f"window ({window_ms} ms)",
+        y_label=f"{channel} per window",
+    )
 
 
 def metrics_table(

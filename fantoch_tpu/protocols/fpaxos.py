@@ -30,10 +30,14 @@ here and driven by the fault-injection subsystem (engine/faults.py):
   link warm between commands); the `leader_check` periodic event (enabled
   by `Config.leader_check_interval_ms`) raises suspicion after
   `leader_timeout_ms` of silence;
-- the DESIGNATED CANDIDATE — the process after the leader in id order —
-  starts the MultiSynod recovery round at ballot `n + pid + 1` (> any
-  initial ballot, owner-recoverable as `(ballot - 1) % n`): one `MPrepare`
-  covers every slot (synod.prepare_row, the multi-decree phase-1);
+- the DESIGNATED CANDIDATE — the first *alive* successor of the leader in
+  id order (the crash schedule is `Env` data, i.e. a perfect failure
+  detector, so chained failures — leader and next-in-line down together —
+  still elect deterministically; fault-free builds keep the static
+  `leader + 1`) — starts the MultiSynod recovery round at ballot
+  `n + pid + 1` (> any initial ballot, owner-recoverable as
+  `(ballot - 1) % n`): one `MPrepare` covers every slot
+  (synod.prepare_row, the multi-decree phase-1);
 - acceptors promise (raising the shared `acc_ballot` register, which
   fences the old leader's commanders) and then STREAM their accepted
   per-slot values to the candidate, `recovery_k` slots per periodic fire
@@ -77,6 +81,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core import ids
+from ..engine import faults as faults_mod
 from ..engine.types import (
     ExecOut,
     ProtocolDef,
@@ -532,7 +537,24 @@ def make_protocol(
         SLOTS = st.acc_has.shape[1]
 
         suspect = (now - st.last_heard[p]) > leader_timeout_ms
-        is_cand = ctx.pid == (st.cur_leader[p] + 1) % n
+        # DESIGNATED CANDIDATE: the first *alive* successor of the
+        # suspected leader in id order. The static `leader + 1` leaves a
+        # chained failure (leader and designated candidate crash
+        # together) headless; the crash schedule is Env data — the
+        # perfect failure detector — so every process agrees on the
+        # first successor whose crash window does not cover `now`.
+        # Fault-free builds keep the static candidate (identical HLO).
+        succ = (
+            st.cur_leader[p] + 1 + jnp.arange(n, dtype=jnp.int32)
+        ) % n
+        if ctx.env.crash_at is not None:
+            succ_dead = faults_mod.crashed_at(ctx.env, succ, now)
+            # argmin picks the first False (alive); an all-dead ring
+            # degenerates back to leader + 1 (nothing can recover anyway)
+            cand = succ[jnp.argmin(succ_dead)]
+        else:
+            cand = succ[0]
+        is_cand = ctx.pid == cand
         start = (
             is_cand & suspect
             & (st.rec_phase[p] == REC_IDLE) & (st.rec_ballot[p] == 0)

@@ -208,6 +208,37 @@ def test_fpaxos_leader_failover_resumes_committing():
     assert int(st.proto.commit_count[0]) < int(st.proto.commit_count[1])
 
 
+def test_fpaxos_chained_failover_skips_dead_candidate():
+    """Leader AND its designated candidate crash together: candidate
+    selection walks the successor ring to the first ALIVE process (the
+    crash schedule is Env data — a perfect failure detector), so process
+    2 runs the recovery instead of the dead `leader + 1`. f=2 keeps the
+    promise quorum (n - f = 3) available among the three survivors."""
+    from fantoch_tpu.protocols import fpaxos
+
+    cfg = dict(n=5, f=2, victim=0, cmds=4, leader=1,
+               regions=["europe-west2", "europe-west4", "us-west1",
+                        "us-west2", "us-central1"])
+    sched = FaultSchedule(crash={0: (250, None), 1: (250, None)})
+    spec, pdef, wl, env = build(
+        "fpaxos", cfg, sched, leader_check=10, deadline_ms=120_000,
+    )
+    st = run(spec, pdef, wl, env)
+
+    assert int(st.dropped) == 0
+    assert bool(st.all_done), (
+        "clients must complete after the chained failover"
+    )
+    # the first ALIVE successor (process 2) drove recovery to DONE and
+    # every survivor now follows it
+    assert int(st.proto.rec_phase[2]) == fpaxos.REC_DONE
+    for p in (2, 3, 4):
+        assert int(st.proto.cur_leader[p]) == 2
+    assert int(pdef.metrics(st.proto)["failovers"].sum()) == 1
+    total = spec.n_clients * spec.commands_per_client
+    assert int(st.proto.frontier[2]) >= total
+
+
 def test_fpaxos_failover_availability_surfacing(tmp_path):
     """Open-loop failover run -> recovery stats + the plot/ recovery
     family (the availability/recovery-latency numbers of the ISSUE)."""

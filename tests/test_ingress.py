@@ -363,13 +363,17 @@ def test_lat_bucket_edges():
 
 
 @pytest.mark.slow
-def test_stall_watchdog_aborts_wedged_feed():
+def test_stall_watchdog_aborts_wedged_feed(tmp_path):
     from fantoch_tpu.engine import faults as faults_mod
+    from fantoch_tpu.telemetry import load_flight_dump
 
     # processes 1 and 2 crash permanently at t=0: >f failures, so no
     # quorum ever forms — submits are admitted but can never complete;
     # process 0's timers keep simulated time advancing, so the liveness
-    # alarm (live_stall_gap_ms over the drained completion series) fires
+    # alarm (live_stall_gap_ms over the drained completion series) fires.
+    # Permanent crashes get NO recovery allowance (fault_quiet_ms == 0),
+    # so the schedule-aware alarm still aborts, and the flight recorder
+    # leaves a parseable post-mortem naming the schedule.
     sched = faults_mod.FaultSchedule(
         crash={1: (0, None), 2: (0, None)}
     )
@@ -380,7 +384,9 @@ def test_stall_watchdog_aborts_wedged_feed():
                                     batch_max_size=1),
     )
     mesh = quantum.make_mesh(3)
-    rt = ServeRuntime(ing, mesh, env, window_ms=50, stall_gap_ms=600)
+    flight = str(tmp_path / "wedge.flight.json")
+    rt = ServeRuntime(ing, mesh, env, window_ms=50, stall_gap_ms=600,
+                      flight_path=flight, faults=sched)
     feed = SyntheticOpenLoopTrace(
         clients=2, interval_ms=25, commands_per_client=2, key_space=4,
         seed=1,
@@ -390,6 +396,140 @@ def test_stall_watchdog_aborts_wedged_feed():
     assert report["aborted"] == "stall"
     assert report["stall_gap_ms"] > 600
     assert report["completed"] < report["issued"]
+    assert report["fault_quiet_ms"] == 0
+    assert report["fault_schedule"]["crash"] == [[1, 0, -1], [2, 0, -1]]
+    dump = load_flight_dump(flight)
+    assert dump["reason"] == "stall_abort"
+    assert dump["extra"]["stall_gap_ms"] > 600
+    assert dump["extra"]["fault_schedule"]["crash"] == [[1, 0, -1],
+                                                        [2, 0, -1]]
+
+
+# ---------------------------------------------------------------------------
+# chaos serving: fault schedules under live load (ISSUE 16 tentpole)
+# ---------------------------------------------------------------------------
+
+
+def test_stall_alarm_recovery_aware():
+    """The liveness alarm's schedule awareness, host-side only: silence
+    inside a scheduled outage window is recovery-in-progress; silence
+    after every scheduled heal — or under a permanent crash — is a real
+    stall."""
+    from fantoch_tpu.engine.faults import FaultSchedule
+    from fantoch_tpu.ingress import fault_quiet_ms
+
+    sched = FaultSchedule(crash={0: (100, 800), 1: (50, None)},
+                          partition=((0,), 200, 1200))
+    # heal edges only: crash 0 recovers at 800, the partition heals at
+    # 1200; the PERMANENT crash of 1 contributes nothing
+    assert fault_quiet_ms(sched) == 1200
+    assert fault_quiet_ms(None) == 0
+    assert fault_quiet_ms(FaultSchedule(crash={0: (100, None)})) == 0
+
+    rt = object.__new__(ServeRuntime)
+    rt.stall_gap_ms = 500
+    rt.admitted_logical, rt.completed_logical = 10, 3
+    rt._last_progress_ms = 100
+    rt._fault_quiet_ms = 1200
+    rt.sim_now = 1100
+    assert rt._stalled() is None  # outage open: recovery-in-progress
+    rt.sim_now = 1600
+    assert rt._stalled() is None  # 400 ms past the heal < stall_gap_ms
+    rt.sim_now = 1800
+    assert rt._stalled() == 600.0  # healed and still silent: real stall
+    rt._fault_quiet_ms = 0  # permanent crashes: no allowance
+    rt.sim_now = 700
+    assert rt._stalled() == 600.0
+    rt.completed_logical = 10
+    assert rt._stalled() is None  # nothing outstanding
+
+
+def test_failover_report_off_device():
+    """`failover_report` is a pure host drain: p50/p99 of completions at
+    or after the first crash instant + the outage/recovery edge."""
+    from fantoch_tpu.engine.faults import FaultSchedule
+    from fantoch_tpu.exp.serve import failover_report
+    from fantoch_tpu.obs.trace import TraceSpec
+
+    tspec = TraceSpec(window_ms=100, max_windows=8,
+                      channels=("done", "lat"))
+    done = np.zeros((1, 8, 1), np.int32)
+    lat = np.zeros((1, 8, 1, 8), np.int32)
+    done[0, 0, 0] = 4  # pre-crash completions
+    done[0, 5, 0] = 3  # the recovery edge
+    lat[0, 0, 0, 1] = 4
+    lat[0, 5, 0, 6] = 3  # through-failover latencies are large
+    st = types.SimpleNamespace(trace={"done": done, "lat": lat})
+
+    fo = failover_report(st, tspec, FaultSchedule(crash={1: (210, 900)}))
+    assert fo["schedule"]["crash"] == [[1, 210, 900]]
+    assert fo["crash_ms"] == 210
+    # crash window w0=2; windows 2..4 dark, completions resume in 5
+    assert fo["outage_windows"] == 3
+    assert fo["recovered_ms"] == 500
+    assert fo["through_failover"]["count"] == 3
+    assert (fo["through_failover"]["p99_ms"]
+            >= fo["through_failover"]["p50_ms"] > 0)
+
+    # > f permanent crash: the tail stays dark — no recovery edge
+    st2 = types.SimpleNamespace(
+        trace={"done": np.where(np.arange(8)[None, :, None] < 2, done, 0),
+               "lat": lat}
+    )
+    fo2 = failover_report(
+        st2, tspec, FaultSchedule(crash={1: (210, None), 2: (210, None)})
+    )
+    assert fo2["recovered_ms"] is None
+    assert fo2["outage_windows"] == 6
+
+    # no crash scheduled (lottery-only chaos): schedule echo only
+    fo3 = failover_report(st, tspec, FaultSchedule(drop_pct=5))
+    assert fo3["schedule"]["drop_pct"] == 5
+    assert "crash_ms" not in fo3
+
+
+@pytest.mark.slow
+def test_serve_through_leader_failover(tmp_path):
+    """The ISSUE 16 serving acceptance: an fpaxos leader crash (<= f)
+    fires mid-stream under live open-loop load; every issued command
+    completes through the failover, and the report carries the
+    p50/p99-through-failover block and the recovery edge."""
+    from fantoch_tpu.engine import faults as faults_mod
+    from fantoch_tpu.exp.serve import run_serve
+
+    rep = run_serve(
+        "fpaxos", 3, 1,
+        logical_clients=8, commands_per_client=8, interval_ms=60,
+        rifl_window=32, ring_slots=32, mega_k=2, window_ms=50,
+        clients_per_region=2, key_space=16,
+        # the leader (Config.leader=1 -> process 0) sits in a region no
+        # client connects to: clients ride processes 1/2 and their
+        # submits are FORWARDED to the leader — the crash severs exactly
+        # the protocol plane, the chaos-serving contract under test
+        process_regions=["europe-west2", "us-west1", "us-west2"],
+        client_regions=["us-west1", "us-west2"],
+        faults=faults_mod.FaultSchedule(crash={0: (250, None)}),
+        leader_check_ms=10,
+        stall_gap_ms=30_000,
+        max_wall_s=600,
+        flight_path=str(tmp_path / "failover.flight.json"),
+    )
+    assert rep["aborted"] is None
+    assert rep["completed"] == rep["issued"] == 64
+    assert rep["syncs_per_megachunk"] == 1.0
+    assert rep["fault_quiet_ms"] == 0  # permanent crash: no allowance
+    fo = rep["failover"]
+    assert fo["crash_ms"] == 250
+    assert fo["schedule"]["crash"] == [[0, 250, -1]]
+    # completions resumed after the failover window and the through-
+    # failover percentiles cover every post-crash completion
+    assert fo["recovered_ms"] is not None
+    assert fo["through_failover"]["count"] > 0
+    assert (fo["through_failover"]["p99_ms"]
+            >= fo["through_failover"]["p50_ms"] > 0)
+    # the whole-run drain saw the outage too: some window after the
+    # crash is dark while the candidate ran recovery
+    assert rep["latency"]["overall"]["count"] == 64
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,204 @@
+"""Vmapped nemesis grids (ISSUE 16 tentpole part 2).
+
+A `FaultSchedule` lowers to pure `Env` arrays, so a whole nemesis matrix
+rides the sweep batch axis: `sweep.stack_nemesis` broadcasts one base
+config across `[B]` schedules and `run_batch` executes every scenario in
+ONE device call. The contract under test:
+
+1. **Bit-identity**: every vmapped scenario is leaf-for-leaf identical
+   to the same schedule run individually (vmap is pure batching, and
+   the drop/dup lotteries hash content-derived message identities that
+   do not depend on the batch).
+2. **Generator**: `mc.enumerate_nemesis_schedules` emits the deduped
+   cartesian fault matrix (crash subsets x times x partitions x
+   lotteries), keyed by effective Env fields.
+3. **Drain**: `summary.grid_recovery_stats` summarizes the batch into
+   the per-scenario availability/recovery rows the heatmap figures
+   (`plot.plots.nemesis_heatmap` / `nemesis_recovery_plot`) render.
+"""
+import dataclasses
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from fantoch_tpu.core.config import Config
+from fantoch_tpu.core.planet import Planet
+from fantoch_tpu.core.workload import KeyGen, Workload
+from fantoch_tpu.engine import lockstep, setup, summary, sweep
+from fantoch_tpu.engine.faults import FaultSchedule
+from fantoch_tpu.mc import enumerate_nemesis_schedules
+
+REGIONS3 = ["asia-east1", "us-central1", "us-west1"]
+CREGIONS = ["us-west1", "us-west2"]
+
+
+def _build(cmds=3, deadline_ms=3000, faults_dup=False):
+    from fantoch_tpu.protocols import basic
+
+    planet = Planet.new()
+    config = Config(n=3, f=1, gc_interval_ms=100)
+    wl = Workload(1, KeyGen.conflict_pool(100, 2), 1, cmds)
+    pdef = basic.make_protocol(3, 1)
+    spec = setup.build_spec(
+        config, wl, pdef, n_clients=2, n_client_groups=2, extra_ms=1000,
+        max_steps=5_000_000, faults=True, faults_dup=faults_dup,
+        deadline_ms=deadline_ms,
+    )
+    placement = setup.Placement(REGIONS3, CREGIONS, 1)
+    env = setup.build_env(spec, config, planet, placement, wl, pdef)
+    return spec, pdef, wl, env, (config, planet, placement)
+
+
+def _row(tree, b):
+    return jax.tree_util.tree_map(lambda x: np.asarray(x)[b], tree)
+
+
+def _assert_rows_equal(batch_st, single_st, b, label):
+    fa, ta = jax.tree_util.tree_flatten(_row(batch_st, b))
+    fb, tb = jax.tree_util.tree_flatten(
+        jax.tree_util.tree_map(np.asarray, single_st)
+    )
+    assert ta == tb
+    for i, (x, y) in enumerate(zip(fa, fb)):
+        np.testing.assert_array_equal(
+            x, y, err_msg=f"scenario {label}: leaf {i} diverges "
+            "between the vmapped grid and the individual run"
+        )
+
+
+def test_enumerate_nemesis_schedules_dedup():
+    # 1 empty subset (every crash-time variant collapses) + 3 singletons
+    # x 2 times = 7 crash variants, x 2 drop values = 14 distinct
+    scheds = enumerate_nemesis_schedules(
+        3, 1, crash_times=(100, 200), drop_pcts=(0, 3),
+    )
+    assert len(scheds) == 14
+    keys = {
+        tuple(sorted((k, np.asarray(v).tobytes())
+                     for k, v in s.env_fields(3).items()))
+        for s in scheds
+    }
+    assert len(keys) == len(scheds)
+    assert scheds[0] == FaultSchedule()  # the fault-free baseline row
+    # partition + dup axes multiply in; max_crashes=0 drops the subsets
+    scheds = enumerate_nemesis_schedules(
+        3, 1, max_crashes=0, partitions=(None, ((0,), 40, 60)),
+        dup_pcts=(0, 5),
+    )
+    assert len(scheds) == 4
+    # recover_after_ms offsets from each crash time
+    scheds = enumerate_nemesis_schedules(
+        3, 1, crash_times=(100,), recover_after_ms=200,
+    )
+    assert all(
+        rec == at + 200
+        for s in scheds for at, rec in s.crash.values()
+    )
+
+
+def _grid_schedules():
+    # 8 scenarios in one compile bucket (all dup-free): the fault-free
+    # row, three single-crash rows, and the same four at drop_pct=2
+    return enumerate_nemesis_schedules(
+        3, 1, crash_times=(100,), recover_after_ms=400, drop_pcts=(0, 2),
+    )
+
+
+def test_nemesis_grid_bit_identity_and_drain(tmp_path):
+    schedules = _grid_schedules()
+    assert len(schedules) == 8
+    spec, pdef, wl, env, (config, planet, placement) = _build()
+    batched = sweep.stack_nemesis(env, schedules)
+    # stack_nemesis rows ARE build_env's own lowering of each schedule
+    for b, s in enumerate(schedules):
+        env_b = setup.build_env(spec, config, planet, placement, wl, pdef,
+                                faults=s)
+        got_leaves = jax.tree_util.tree_flatten(_row(batched, b))[0]
+        want_leaves = jax.tree_util.tree_flatten(
+            jax.tree_util.tree_map(np.asarray, env_b)
+        )[0]
+        assert len(got_leaves) == len(want_leaves)
+        for i, (got, want) in enumerate(zip(got_leaves, want_leaves)):
+            np.testing.assert_array_equal(
+                got, want, err_msg=f"schedule {s!r}: env leaf {i}"
+            )
+
+    st = jax.tree_util.tree_map(
+        np.asarray, sweep.run_batch(spec, pdef, wl, batched)
+    )
+    run1 = jax.jit(lockstep.make_run(spec, pdef, wl))
+    for b, s in enumerate(schedules):
+        _assert_rows_equal(st, run1(_row(batched, b)), b, repr(s))
+
+    stats = summary.grid_recovery_stats(st)
+    assert stats["availability"].shape == (8,)
+    # the fault-free scenario completes everything; recovering <= f
+    # crashes keep availability at 1.0 too (the failover contract)
+    assert stats["availability"][0] == 1.0
+    assert stats["completed"][0] > 0
+    assert (stats["availability"] <= 1.0).all()
+    assert bool(stats["all_done"][0])
+
+    # drained summaries -> results dir -> heatmap figures (the same
+    # save_sweep/ResultsDB path run_grid persists through)
+    from fantoch_tpu.exp.harness import Point, nemesis_points
+    from fantoch_tpu.plot import db as results_db
+    from fantoch_tpu.plot.db import ResultsDB
+    from fantoch_tpu.plot.plots import nemesis_heatmap
+
+    pts = nemesis_points(
+        Point(protocol="basic", n=3, f=1, clients_per_region=1,
+              commands_per_client=3, deadline_ms=3000),
+        schedules,
+    )
+    assert len(pts) == len(schedules)
+    assert pts[0].crash == () and pts[0].drop_pct == 0
+    assert any(p.crash and p.crash[0][2] == 500 for p in pts)
+    root = str(tmp_path / "results")
+    results_db.save_sweep(
+        root, "nemesis_b0", [p.search() for p in pts],
+        hist=np.asarray(st.hist),
+        issued=np.asarray(st.c_issued),
+        client_group=np.stack([np.asarray(env.client_group)] * 8),
+        sim_time_ms=np.minimum(
+            np.asarray(st.final_time), spec.deadline_ms
+        ),
+        steps=np.asarray(st.step),
+        client_regions=CREGIONS,
+        metrics={},
+    )
+    db = ResultsDB.load(root)
+    assert len(db) == 8
+    fig = nemesis_heatmap(
+        list(db), str(tmp_path / "avail.png"), value="availability"
+    )
+    assert os.path.exists(fig)
+    fig = nemesis_heatmap(
+        list(db), str(tmp_path / "p99.png"), value="p99_ms"
+    )
+    assert os.path.exists(fig)
+
+
+@pytest.mark.heavy
+def test_nemesis_grid_64_scenarios_one_call():
+    """The ISSUE 16 acceptance grid: >= 64 schedules vmapped into one
+    device call, every scenario bit-identical to its individual run."""
+    schedules = enumerate_nemesis_schedules(
+        3, 1, crash_times=(100, 250), recover_after_ms=400,
+        partitions=(None, ((0,), 40, 80)),
+        drop_pcts=(0, 1, 2, 3, 4),
+    )
+    assert len(schedules) >= 64, len(schedules)
+    spec, pdef, wl, env, _ = _build(cmds=2, deadline_ms=2000)
+    batched = sweep.stack_nemesis(env, schedules)
+    st = jax.tree_util.tree_map(
+        np.asarray, sweep.run_batch(spec, pdef, wl, batched)
+    )
+    run1 = jax.jit(lockstep.make_run(spec, pdef, wl))
+    for b, s in enumerate(schedules):
+        _assert_rows_equal(st, run1(_row(batched, b)), b, repr(s))
+    stats = summary.grid_recovery_stats(st)
+    assert stats["availability"][0] == 1.0
+    assert (stats["availability"] <= 1.0).all()
