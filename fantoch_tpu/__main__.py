@@ -391,6 +391,85 @@ def cmd_serve(args) -> int:
     return 0 if not report.get("aborted") else 1
 
 
+def cmd_fleet(args) -> int:
+    """Fleet scheduler (fantoch_tpu/fleet): bin-pack a heterogeneous
+    sweep grid across a pool of worker processes, compile-once
+    fleet-wide through the shared AOT store, survive worker deaths via
+    the per-bucket resume path, and print the run report JSON (the
+    compile-once audit rides in it). `--worker` is the process-side
+    entry the parent spawns — line-JSON ops on stdin, not for hand use."""
+    if args.worker:
+        from .fleet.worker import worker_main
+
+        return worker_main()
+
+    from .exp.harness import Point
+    from .fleet.scheduler import run_fleet
+
+    points = []
+    for proto in _csv(args.protocols):
+        for n in _icsv(args.ns):
+            # EPaxos ignores the configured f (always tolerates a
+            # minority): one representative f, like `sweep`
+            fs = _icsv(args.fs)[:1] if proto == "epaxos" else _icsv(args.fs)
+            for f in fs:
+                if f > (n - 1) // 2:
+                    continue
+                for conflict in _icsv(args.conflicts):
+                    for clients in _icsv(args.clients):
+                        for seed in range(args.seeds):
+                            points.append(Point(
+                                protocol=proto,
+                                n=n,
+                                f=f,
+                                clients_per_region=clients,
+                                conflict_rate=conflict,
+                                commands_per_client=args.commands,
+                                seed=seed,
+                            ))
+    if not points:
+        print("fleet: empty grid", file=sys.stderr)
+        return 2
+    grids = [{
+        "name": args.name,
+        "points": points,
+        "planet_dataset": args.planet_dataset or None,
+        "process_regions": _csv(args.process_regions) or None,
+        "client_regions": _csv(args.client_regions) or None,
+    }]
+    cache_dir = None
+    if not args.no_aot_cache:
+        from .cache.store import default_root
+
+        cache_dir = args.aot_cache_dir or default_root()
+        os.makedirs(cache_dir, exist_ok=True)
+    try:
+        report = run_fleet(
+            grids,
+            workers=args.workers,
+            results_root=args.results,
+            chunk_steps=args.chunk_steps,
+            cache_dir=cache_dir,
+            resume=args.resume,
+            metrics_out=args.metrics_out or None,
+            metrics_interval_s=args.metrics_interval,
+            kill_after_done=args.kill_after if args.kill_after >= 0 else None,
+            bucket_budget_s=args.bucket_budget,
+            figures_out=args.figures or None,
+            verbose=args.verbose,
+        )
+    except Exception as e:  # noqa: BLE001 — one parseable error line
+        print(json.dumps({"error": f"{type(e).__name__}: {e}"[:500]}))
+        return 1
+    print(json.dumps(report))
+    # compile-once is the subsystem's contract: a clean run that broke it
+    # must not exit green
+    if report.get("compile_once") is False or \
+            report.get("compile_once_exact") is False:
+        return 1
+    return 0
+
+
 def cmd_lint(args) -> int:
     """Static engine-contract checker (fantoch_tpu/analysis): trace the
     jitted engine programs for the requested protocol x engine x trace x
@@ -1049,6 +1128,61 @@ def main(argv=None) -> int:
                     help="render the host-overhead timeline figure from"
                          " the run's snapshot stream (needs --metrics-out)")
     pv.set_defaults(fn=cmd_serve)
+
+    pf = sub.add_parser(
+        "fleet",
+        help="compile-once fleet scheduler: bin-pack a sweep grid across"
+             " worker processes through the shared AOT store, survive"
+             " worker deaths, print the run report (fantoch_tpu/fleet)",
+    )
+    pf.add_argument("--worker", action="store_true",
+                    help="run as a fleet worker process (line-JSON ops on"
+                         " stdin; spawned by the parent, not for hand use)")
+    pf.add_argument("--workers", type=int, default=2,
+                    help="worker process pool size")
+    pf.add_argument("--protocols", default="tempo,atlas,epaxos")
+    pf.add_argument("--ns", default="3,5",
+                    help="CSV of system sizes (each its own shape bucket)")
+    pf.add_argument("--fs", default="1,2")
+    pf.add_argument("--conflicts", default="2,10,50,100")
+    pf.add_argument("--clients", default="1,2,4")
+    pf.add_argument("--commands", type=int, default=100)
+    pf.add_argument("--seeds", type=int, default=1,
+                    help="seeds 0..N-1 per config (Env data — free)")
+    pf.add_argument("--planet-dataset", default="",
+                    help="latency dataset (default: the GCP planet)")
+    pf.add_argument("--process-regions", default="")
+    pf.add_argument("--client-regions", default="")
+    pf.add_argument("--results", default="results")
+    pf.add_argument("--name", default="fleet")
+    pf.add_argument("--chunk-steps", type=int, default=1500)
+    pf.add_argument("--aot-cache-dir", default="",
+                    help="SHARED executable store all workers publish/load"
+                         " through (default: the shared root; compile-once"
+                         " is defined over it)")
+    pf.add_argument("--no-aot-cache", action="store_true",
+                    help="disable the shared store (every worker compiles"
+                         " privately; compile-once audit vacuous)")
+    pf.add_argument("--resume", action="store_true",
+                    help="skip buckets whose results dirs already match"
+                         " (run_grid's resume fingerprints, shared with"
+                         " serial runs)")
+    pf.add_argument("--kill-after", type=int, default=-1,
+                    help="chaos hook: SIGKILL one busy worker after this"
+                         " many bucket completions (-1 = off)")
+    pf.add_argument("--bucket-budget", type=float, default=3600.0,
+                    help="per-bucket dispatch budget seconds (a worker"
+                         " over it is killed and its buckets requeued)")
+    pf.add_argument("--figures", default="",
+                    help="emit the EuroSys figure set from the results"
+                         " root into this directory")
+    pf.add_argument("--metrics-out", default="",
+                    help="Prometheus textfile of the fleet telemetry"
+                         " (dispatch/compile spans, worker gauges) on an"
+                         " interval; .jsonl snapshots beside it")
+    pf.add_argument("--metrics-interval", type=float, default=10.0)
+    pf.add_argument("--verbose", action="store_true")
+    pf.set_defaults(fn=cmd_fleet)
 
     pl = sub.add_parser(
         "lint",

@@ -131,6 +131,12 @@ class ExecutableStore:
         self.misses = 0
         self.corrupt = 0
         self.unserializable = 0
+        # per-resolution event log (one record per get_or_compile, the
+        # `info` dict): the fleet scheduler's compile-once accounting
+        # reads these — a fleet worker drains its slice after each bucket
+        # and ships the records to the parent, which checks that no key
+        # missed (compiled) more than once fleet-wide
+        self.events: List[Dict[str, Any]] = []
         # keys whose executables this backend cannot serialize (learned
         # in-process or from a persisted meta marker): later misses on
         # them compile through the NORMAL path — native persistent cache
@@ -198,6 +204,7 @@ class ExecutableStore:
                     load_s=round(time.perf_counter() - t0 - info["trace_s"],
                                  3),
                 )
+                self.events.append(dict(info))
                 return compiled, info
             except Exception as e:  # noqa: BLE001 — any load failure
                 # truncated/corrupted/incompatible entry: recompile and
@@ -216,6 +223,7 @@ class ExecutableStore:
             info["compile_s"] = round(time.perf_counter() - t1, 3)
             info["unserializable"] = "marked"
             self.misses += 1
+            self.events.append(dict(info))
             return compiled, info
         compiled = self._compile(traced)
         info["compile_s"] = round(time.perf_counter() - t1, 3)
@@ -234,6 +242,7 @@ class ExecutableStore:
             "created": time.time(),
             "compile_s": info["compile_s"],
         }, info)
+        self.events.append(dict(info))
         return compiled, info
 
     @staticmethod
@@ -354,6 +363,13 @@ class ExecutableStore:
         return {"hits": self.hits, "misses": self.misses,
                 "corrupt": self.corrupt,
                 "unserializable": self.unserializable}
+
+    def drain_events(self) -> List[Dict[str, Any]]:
+        """Return and clear the resolution-event log (counters untouched):
+        consumers that account per work unit — the fleet worker reports
+        one slice per bucket — take deltas without index bookkeeping."""
+        out, self.events = self.events, []
+        return out
 
     def entries(self) -> List[Dict[str, Any]]:
         out = []

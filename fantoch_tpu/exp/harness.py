@@ -241,6 +241,32 @@ def nemesis_points(base: Point, schedules) -> List[Point]:
     return out
 
 
+def point_to_dict(pt: Point) -> Dict[str, Any]:
+    """JSON-safe Point serialization (the fleet wire format): field names
+    unchanged (unlike `search()`, which renames for the results DB), fault
+    tuples as lists. Round-trips through `point_from_dict`."""
+    d = dataclasses.asdict(pt)
+    d["crash"] = [list(c) for c in pt.crash]
+    d["partition"] = (
+        [list(pt.partition[0]), pt.partition[1], pt.partition[2]]
+        if pt.partition
+        else []
+    )
+    return d
+
+
+def point_from_dict(d: Dict[str, Any]) -> Point:
+    d = dict(d)
+    d["crash"] = tuple(tuple(int(x) for x in c) for c in d.get("crash") or ())
+    part = d.get("partition") or ()
+    d["partition"] = (
+        (tuple(int(x) for x in part[0]), int(part[1]), int(part[2]))
+        if part
+        else ()
+    )
+    return Point(**d)
+
+
 def _bucket_key(pt: Point) -> Tuple:
     return (
         pt.protocol,
@@ -341,6 +367,138 @@ def _point_config(pt: Point, n: int, gc_interval_ms: int,
     )
 
 
+def grid_buckets(points: Sequence[Point]) -> List[List[Point]]:
+    """The shape buckets of a grid in `run_grid`'s exact order: bucket `bi`
+    here is the bucket `run_grid` persists as `<name>_b{bi}` — the fleet
+    scheduler plans against this indexing and workers select with
+    `run_grid(..., only_buckets=[bi])`, so both sides agree by
+    construction."""
+    buckets: Dict[Tuple, List[Point]] = {}
+    for pt in points:
+        buckets.setdefault(_bucket_key(pt), []).append(pt)
+    return [bpoints for _, bpoints in sorted(buckets.items())]
+
+
+@dataclasses.dataclass
+class _BucketSetup:
+    """One shape bucket's compile-relevant construction — the material
+    `run_grid` and `bucket_exec_signature` share."""
+
+    pt0: Point
+    n: int
+    pregions: List[str]
+    C: int
+    wl: Workload
+    fingerprint: Dict[str, Any]
+    max_seq: int
+    pdef: ProtocolDef
+    leader: Optional[int]
+    placement: Any
+    config0: Config
+    spec: Any
+
+
+def _bucket_setup(bpoints, *, planet, process_regions, client_regions,
+                  gc_interval_ms, extra_ms, max_steps, pool_slots,
+                  trace) -> _BucketSetup:
+    pt0 = bpoints[0]
+    n = pt0.n
+    pregions = list(process_regions or [])
+    if not pregions:
+        pregions = [r for r in planet.regions()][:n]
+    assert len(pregions) >= n, "not enough regions for n processes"
+    pregions = pregions[:n]
+    C = len(client_regions) * pt0.clients_per_region
+    wl = pt0.workload()
+    # GC window compaction for the protocols that support slot reuse:
+    # per-dot state (and the graph executor's closure) stays sized by
+    # the in-flight window; submits defer (never drop) under pressure.
+    # FPaxos/Caesar run unwindowed (static dot space).
+    fingerprint = _engine_fingerprint(pt0, C, trace)
+    max_seq = fingerprint["max_seq"]
+    pdef = make_protocol_def(
+        pt0.protocol,
+        n,
+        setup.command_key_slots(wl, pt0.batch_max_size),
+        max_seq=max_seq,
+        key_space_hint=wl.key_space(C),
+        nfr=pt0.nfr,
+        wait_condition=pt0.caesar_wait_condition,
+        clock_bump=pt0.tempo_clock_bump_interval_ms > 0,
+        buffer_detached=pt0.tempo_detached_send_interval_ms > 0,
+        skip_fast_ack=pt0.skip_fast_ack,
+        execute_at_commit=pt0.execute_at_commit,
+    )
+    leader = 1 if not pdef.leaderless else None
+    placement = setup.Placement(pregions, client_regions,
+                                pt0.clients_per_region)
+    config0 = _point_config(pt0, n, gc_interval_ms, leader)
+    spec = setup.build_spec(
+        config0,
+        wl,
+        pdef,
+        n_clients=C,
+        n_client_groups=len(client_regions),
+        max_seq=max_seq,
+        extra_ms=extra_ms,
+        max_steps=max_steps,
+        open_loop_interval_ms=pt0.open_loop_interval_ms or None,
+        batch_max_size=pt0.batch_max_size,
+        batch_max_delay_ms=pt0.batch_max_delay_ms,
+        # tighter in-flight bound for big sweeps (pool size is
+        # the per-event hot-op cost; drops abort via
+        # check_sim_health, so an undersized pool fails loudly)
+        pool_slots=pool_slots,
+        faults=pt0.fault_schedule() is not None,
+        faults_dup=pt0.dup_pct > 0,
+        deadline_ms=pt0.deadline_ms or None,
+        trace=trace,
+    )
+    return _BucketSetup(pt0, n, pregions, C, wl, fingerprint, max_seq,
+                        pdef, leader, placement, config0, spec)
+
+
+def _setup_exec_signature(bs: _BucketSetup, planet, B: int,
+                          chunk_steps: int) -> str:
+    env0 = setup.build_env(
+        bs.spec, bs.config0, planet, bs.placement, bs.wl, bs.pdef,
+        seed=bs.pt0.seed, faults=bs.pt0.fault_schedule(),
+    )
+    return _exec_signature(bs.spec, bs.pdef, bs.wl, env0, B, chunk_steps)
+
+
+def bucket_exec_signature(
+    bpoints: Sequence[Point],
+    chunk_steps: int,
+    *,
+    planet: Optional[Planet] = None,
+    process_regions: Optional[Sequence[str]] = None,
+    client_regions: Optional[Sequence[str]] = None,
+    gc_interval_ms: int = 50,
+    extra_ms: int = 2000,
+    max_steps: int = 50_000_000,
+    pool_slots: Optional[int] = None,
+    trace=None,
+) -> str:
+    """The executable-cache signature of ONE shape bucket's megachunk
+    driver at batch size len(bpoints) — trace-only (no compile, no
+    execution). This is the identity the fleet scheduler groups buckets by
+    (compile-once fleet-wide is defined over it) and the same recipe
+    `run_grid` folds into cache-enabled resume fingerprints; it is a
+    deterministic function of the bucket's shape key + batch size +
+    chunk_steps + the engine contract/env overrides, so callers may
+    memoize on those."""
+    planet = planet or Planet.new()
+    client_regions = list(client_regions or ["us-west1", "us-west2"])
+    bs = _bucket_setup(
+        bpoints, planet=planet, process_regions=process_regions,
+        client_regions=client_regions, gc_interval_ms=gc_interval_ms,
+        extra_ms=extra_ms, max_steps=max_steps, pool_slots=pool_slots,
+        trace=trace,
+    )
+    return _setup_exec_signature(bs, planet, len(bpoints), chunk_steps)
+
+
 def _exec_signature(spec, pdef, wl, env0, B: int, chunk_steps: int) -> str:
     """Structural jaxpr signature of a bucket's megachunk driver program
     at batch size B — the EXECUTABLE identity folded into the sweep-resume
@@ -387,6 +545,7 @@ def run_grid(
     registry=None,
     metrics_out: Optional[str] = None,
     metrics_interval_s: float = 10.0,
+    only_buckets: Optional[Sequence[int]] = None,
 ) -> List[str]:
     """Run every grid point and persist one results dir per shape bucket.
 
@@ -425,6 +584,13 @@ def run_grid(
     `metrics_interval_s` — host-side only, zero change to the compiled
     programs or the per-megachunk sync count.
 
+    `only_buckets` restricts execution to the named shape-bucket indices
+    (the `grid_buckets` / `<name>_b{bi}` indexing) while leaving every
+    bucket's index — and therefore its results-dir name and resume
+    fingerprint — exactly what a full run would use: a fleet worker runs
+    its one assigned bucket of a grid and the serial run of the same grid
+    resumes from (and bit-matches) the result.
+
     Returns the created directories (load them with `ResultsDB.load` on the
     parent root)."""
     if metrics_log and not chunk_steps:
@@ -454,60 +620,23 @@ def run_grid(
     out_dirs: List[str] = []
     if stats is not None:
         stats.update({"buckets": len(buckets), "skipped": 0})
+    only = set(only_buckets) if only_buckets is not None else None
     for bi, (bkey, bpoints) in enumerate(sorted(buckets.items())):
-        pt0 = bpoints[0]
-        n = pt0.n
-        pregions = list(process_regions or [])
-        if not pregions:
-            pregions = [r for r in planet.regions()][:n]
-        assert len(pregions) >= n, "not enough regions for n processes"
-        pregions = pregions[:n]
-        C = len(client_regions) * pt0.clients_per_region
-        wl = pt0.workload()
-        total_cmds = C * pt0.commands_per_client
-        # GC window compaction for the protocols that support slot reuse:
-        # per-dot state (and the graph executor's closure) stays sized by
-        # the in-flight window; submits defer (never drop) under pressure.
-        # FPaxos/Caesar run unwindowed (static dot space).
-        fingerprint = _engine_fingerprint(pt0, C, trace)
-        max_seq = fingerprint["max_seq"]
-        pdef = make_protocol_def(
-            pt0.protocol,
-            n,
-            setup.command_key_slots(wl, pt0.batch_max_size),
-            max_seq=max_seq,
-            key_space_hint=wl.key_space(C),
-            nfr=pt0.nfr,
-            wait_condition=pt0.caesar_wait_condition,
-            clock_bump=pt0.tempo_clock_bump_interval_ms > 0,
-            buffer_detached=pt0.tempo_detached_send_interval_ms > 0,
-            skip_fast_ack=pt0.skip_fast_ack,
-            execute_at_commit=pt0.execute_at_commit,
-        )
-        leader = 1 if not pdef.leaderless else None
-        placement = setup.Placement(pregions, client_regions, pt0.clients_per_region)
-        config0 = _point_config(pt0, n, gc_interval_ms, leader)
-        spec = setup.build_spec(
-            config0,
-            wl,
-            pdef,
-            n_clients=C,
-            n_client_groups=len(client_regions),
-            max_seq=max_seq,
-            extra_ms=extra_ms,
-            max_steps=max_steps,
-            open_loop_interval_ms=pt0.open_loop_interval_ms or None,
-            batch_max_size=pt0.batch_max_size,
-            batch_max_delay_ms=pt0.batch_max_delay_ms,
-            # tighter in-flight bound for big sweeps (pool size is
-            # the per-event hot-op cost; drops abort via
-            # check_sim_health, so an undersized pool fails loudly)
-            pool_slots=pool_slots,
-            faults=pt0.fault_schedule() is not None,
-            faults_dup=pt0.dup_pct > 0,
-            deadline_ms=pt0.deadline_ms or None,
+        if only is not None and bi not in only:
+            continue
+        bs = _bucket_setup(
+            bpoints, planet=planet, process_regions=process_regions,
+            client_regions=client_regions, gc_interval_ms=gc_interval_ms,
+            extra_ms=extra_ms, max_steps=max_steps, pool_slots=pool_slots,
             trace=trace,
         )
+        pt0 = bs.pt0
+        pregions = bs.pregions
+        wl = bs.wl
+        fingerprint = bs.fingerprint
+        leader = bs.leader
+        pdef = bs.pdef
+        spec = bs.spec
         # EXECUTABLE identity joins the resume fingerprint on chunked
         # megachunk runs: trace-only (no compile) signature of the
         # bucket's driver program — an engine/program change re-runs the
@@ -527,14 +656,8 @@ def run_grid(
         exec_sig: Optional[str] = None
 
         def bucket_exec_sig() -> str:
-            return _exec_signature(
-                spec, pdef, wl,
-                setup.build_env(
-                    spec, config0, planet, placement, wl, pdef,
-                    seed=pt0.seed, faults=pt0.fault_schedule(),
-                ),
-                len(bpoints), chunk_steps,
-            )
+            return _setup_exec_signature(bs, planet, len(bpoints),
+                                         chunk_steps)
 
         if resume:
             # segment-safe restarts for long tunneled sweeps: every bucket
@@ -591,10 +714,11 @@ def run_grid(
         envs = []
         searches = []
         for pt in bpoints:
-            config = _point_config(pt, n, gc_interval_ms, leader)
+            config = _point_config(pt, bs.n, gc_interval_ms, leader)
             envs.append(
                 setup.build_env(
-                    spec, config, planet, placement, pt.workload(), pdef,
+                    spec, config, planet, bs.placement, pt.workload(),
+                    bs.pdef,
                     seed=pt.seed,
                     faults=pt.fault_schedule(),
                 )

@@ -1,0 +1,21 @@
+"""Fleet scheduler — the `fantoch_exp` tier: compile-once orchestration
+of heterogeneous sweep grids across a pool of worker processes.
+
+Import surface is kept lazy so `fantoch_tpu.fleet.plan` stays usable
+without jax installed (pure-host unit tests, CI lint).
+"""
+from __future__ import annotations
+
+__all__ = ["BucketTask", "FleetScheduler", "build_plan", "run_fleet"]
+
+
+def __getattr__(name):
+    if name in ("BucketTask", "FleetScheduler", "build_plan"):
+        from . import plan
+
+        return getattr(plan, name)
+    if name == "run_fleet":
+        from .scheduler import run_fleet
+
+        return run_fleet
+    raise AttributeError(name)

@@ -1,0 +1,212 @@
+"""Fleet plan: signature-keyed buckets + the compile-once claim machine.
+
+The `fantoch_exp` layer of the reference launches machines and hands each
+a share of the experiment grid (`fantoch_exp/src/bench.rs` bench_experiment
+loop). Here the unit of work is a SHAPE BUCKET (one `run_grid` bucket: a
+vmapped batch of configs sharing one compiled program) and the scarce
+resource is COMPILATION, not machines — so the planner keys every bucket
+by its executable-cache signature (`exp/harness.bucket_exec_signature`,
+the same structural jaxpr hash the AOT store keys on) and schedules so
+that each distinct signature is compiled by exactly one worker fleet-wide:
+
+- signatures move `unclaimed -> compiling(worker) -> warm`;
+- a worker asking for work gets, in deterministic plan order, (1) a
+  bucket whose signature is already warm (pure simulation, the shared AOT
+  store serves the executable), else (2) a bucket whose signature is
+  unclaimed — that worker becomes the signature's compiler; buckets whose
+  signature is being compiled by ANOTHER worker are deferred, which is
+  what interleaves compile-on-one-worker with sim-on-the-rest instead of
+  barriering the fleet behind a compile phase;
+- a dead worker's claimed buckets are requeued and any signature it was
+  compiling reverts to unclaimed (the next claimant inherits the compile;
+  if the dead worker published before dying, the store turns the re-run
+  into a warm start — the scheduler does not need to know which).
+
+Pure host Python with NO jax import (unit-tested like `telemetry/`):
+signatures and payloads are opaque strings/objects supplied by the
+caller. Everything is deterministic for a fixed task list — the plan
+order is a pure function of (signature-group total cost, signature,
+bucket cost, bucket id).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+UNCLAIMED = "unclaimed"
+COMPILING = "compiling"
+WARM = "warm"
+
+
+class PlanError(AssertionError):
+    """A scheduling invariant was violated (double claim, unknown bucket,
+    completion by a non-owner) — always a bug in the caller, never load."""
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketTask:
+    """One schedulable unit: a single `run_grid` shape bucket."""
+
+    bucket_id: str        # unique, stable ("<grid name>:b<index>")
+    signature: str        # executable-cache signature of the bucket program
+    cost: float = 1.0     # relative sim weight (configs x commands x n)
+    payload: Any = None   # opaque dispatch payload (the worker request)
+
+
+@dataclasses.dataclass(frozen=True)
+class Claim:
+    task: BucketTask
+    compile: bool  # this claim makes the worker the signature's compiler
+
+
+def build_plan(tasks: Sequence[BucketTask]) -> List[BucketTask]:
+    """Deterministic dispatch order: signature groups longest-total-cost
+    first (LPT — the expensive program's compile starts earliest and its
+    warm siblings fill the fleet behind it), buckets within a group by
+    (cost desc, bucket_id). Ties break on the signature/bucket_id strings,
+    so the same grid always yields the same plan."""
+    groups: Dict[str, List[BucketTask]] = {}
+    for t in tasks:
+        groups.setdefault(t.signature, []).append(t)
+    ordered_sigs = sorted(
+        groups,
+        key=lambda s: (-sum(t.cost for t in groups[s]), s),
+    )
+    out: List[BucketTask] = []
+    for sig in ordered_sigs:
+        out.extend(sorted(groups[sig], key=lambda t: (-t.cost, t.bucket_id)))
+    return out
+
+
+class FleetScheduler:
+    """The claim machine over a fixed task list. Single-threaded by
+    design: the parent's dispatch loop is the only caller (worker
+    processes never see this object), so no locking."""
+
+    def __init__(self, tasks: Sequence[BucketTask]):
+        ids = [t.bucket_id for t in tasks]
+        if len(set(ids)) != len(ids):
+            dup = sorted({i for i in ids if ids.count(i) > 1})
+            raise PlanError(f"duplicate bucket ids {dup}")
+        self.order = build_plan(tasks)
+        self._tasks = {t.bucket_id: t for t in self.order}
+        self._state = {t.bucket_id: "pending" for t in self.order}
+        self._owner: Dict[str, str] = {}
+        self._sig_state = {t.signature: UNCLAIMED for t in self.order}
+        self._sig_owner: Dict[str, str] = {}
+        # accounting
+        self.claims = 0
+        self.requeues = 0
+        self.requeued_ids: List[str] = []
+
+    # -- queries ------------------------------------------------------------
+
+    def done(self) -> bool:
+        return all(s == "done" for s in self._state.values())
+
+    def pending(self) -> int:
+        return sum(1 for s in self._state.values() if s == "pending")
+
+    def claimed(self) -> int:
+        return sum(1 for s in self._state.values() if s == "claimed")
+
+    def signatures(self) -> List[str]:
+        return sorted(self._sig_state)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "pending": self.pending(),
+            "claimed": self.claimed(),
+            "done": sum(1 for s in self._state.values() if s == "done"),
+            "sig_states": dict(self._sig_state),
+            "claims": self.claims,
+            "requeues": self.requeues,
+        }
+
+    # -- transitions --------------------------------------------------------
+
+    def next_for(self, worker: str) -> Optional[Claim]:
+        """Claim the next bucket for `worker`, or None when every pending
+        bucket's signature is being compiled by some OTHER worker (the
+        caller waits — dispatching one would recompile the program a
+        second time). Warm-signature work is preferred over starting a new
+        compile: a free worker simulates while the fleet's compiles are
+        in flight."""
+        chosen: Optional[BucketTask] = None
+        compile_claim = False
+        for t in self.order:
+            if self._state[t.bucket_id] != "pending":
+                continue
+            if self._sig_state[t.signature] == WARM:
+                chosen = t
+                break
+        if chosen is None:
+            for t in self.order:
+                if self._state[t.bucket_id] != "pending":
+                    continue
+                if self._sig_state[t.signature] == UNCLAIMED:
+                    chosen, compile_claim = t, True
+                    break
+        if chosen is None:
+            return None
+        bid = chosen.bucket_id
+        if self._state[bid] != "pending":  # pragma: no cover — guarded above
+            raise PlanError(f"bucket {bid} claimed twice")
+        self._state[bid] = "claimed"
+        self._owner[bid] = worker
+        if compile_claim:
+            self._sig_state[chosen.signature] = COMPILING
+            self._sig_owner[chosen.signature] = bid
+        self.claims += 1
+        return Claim(chosen, compile_claim)
+
+    def _check_owned(self, worker: str, bucket_id: str) -> BucketTask:
+        t = self._tasks.get(bucket_id)
+        if t is None:
+            raise PlanError(f"unknown bucket {bucket_id!r}")
+        if self._state[bucket_id] != "claimed":
+            raise PlanError(
+                f"bucket {bucket_id} is {self._state[bucket_id]!r},"
+                " not claimed"
+            )
+        if self._owner.get(bucket_id) != worker:
+            raise PlanError(
+                f"bucket {bucket_id} owned by"
+                f" {self._owner.get(bucket_id)!r}, not {worker!r}"
+            )
+        return t
+
+    def mark_done(self, worker: str, bucket_id: str) -> None:
+        """`worker` finished `bucket_id`. If this bucket was its
+        signature's compile claim, the executable is now published to the
+        shared store — the signature turns warm and its deferred siblings
+        become claimable."""
+        t = self._check_owned(worker, bucket_id)
+        self._state[bucket_id] = "done"
+        self._owner.pop(bucket_id, None)
+        if self._sig_owner.get(t.signature) == bucket_id:
+            self._sig_state[t.signature] = WARM
+            self._sig_owner.pop(t.signature, None)
+
+    def mark_failed(self, worker: str, bucket_id: str) -> None:
+        """A soft failure (op error, timeout) on a live worker: requeue
+        the bucket; a compile claim reverts its signature to unclaimed."""
+        t = self._check_owned(worker, bucket_id)
+        self._requeue(t)
+
+    def worker_died(self, worker: str) -> List[str]:
+        """Requeue every bucket `worker` held; signatures it was compiling
+        revert to unclaimed. Returns the requeued bucket ids."""
+        held = [b for b, w in self._owner.items() if w == worker]
+        for bid in held:
+            self._requeue(self._tasks[bid])
+        return held
+
+    def _requeue(self, t: BucketTask) -> None:
+        self._state[t.bucket_id] = "pending"
+        self._owner.pop(t.bucket_id, None)
+        if self._sig_owner.get(t.signature) == t.bucket_id:
+            self._sig_state[t.signature] = UNCLAIMED
+            self._sig_owner.pop(t.signature, None)
+        self.requeues += 1
+        self.requeued_ids.append(t.bucket_id)

@@ -1,0 +1,533 @@
+"""Fleet scheduler parent: spawn workers, dispatch buckets, audit compile-once.
+
+The orchestration layer the reference keeps in `fantoch_exp` (launch
+machines, hand each a share of the grid, pull metrics, survive machine
+loss). Here the "machines" are persistent worker processes
+(`python -m fantoch_tpu fleet --worker`, the bench's warm-worker
+line-JSON protocol) and the shared resource is the AOT executable store:
+the parent derives every bucket's executable-cache signature by TRACING
+ONLY (`exp/harness.bucket_exec_signature` — no compile happens in the
+parent), feeds the claim machine (`fleet/plan.py`), and dispatches so
+each distinct program compiles exactly once fleet-wide while already-warm
+buckets fill the other workers.
+
+Fault model: a worker that dies mid-bucket (crash, OOM, SIGKILL chaos)
+loses nothing durable — results dirs publish atomically (data.npz last)
+and executables publish META-FIRST to the store — so the parent requeues
+its claimed buckets, respawns the process, and the re-run either resumes
+from the results dir (published before death) or re-executes warm from
+the store. The end-of-run report audits the compile-once invariant from
+the workers' drained cache events: `fleet_compile_misses` must equal the
+number of distinct signatures on a clean cold run, and no store key may
+miss twice under any schedule.
+"""
+from __future__ import annotations
+
+import json
+import os
+import queue
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from .plan import BucketTask, Claim, FleetScheduler, PlanError
+
+READY_TIMEOUT_S = 300.0
+MAX_BUCKET_ATTEMPTS = 3
+
+
+class _WorkerProc:
+    """Handle on one fleet worker subprocess: line-JSON requests on stdin,
+    replies read through a daemon thread (waits can time out without
+    racing buffered text IO), stderr passed through — `bench.py`'s
+    `Worker`, minus the bench-specific env plumbing, plus a non-blocking
+    `try_read` for the parent's multi-worker poll loop."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "fantoch_tpu", "fleet", "--worker"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, stderr=None,
+            text=True, bufsize=1, env=dict(os.environ),
+        )
+        self.q: "queue.Queue" = queue.Queue()
+        self.t = threading.Thread(target=self._reader, daemon=True)
+        self.t.start()
+
+    def _reader(self):
+        try:
+            for line in self.proc.stdout:
+                self.q.put(line)
+        except (OSError, ValueError):
+            pass
+        self.q.put(None)  # EOF sentinel: the worker is gone
+
+    def _parse(self, line) -> Optional[Dict[str, Any]]:
+        if line is None:
+            return None
+        try:
+            cand = json.loads(line)
+        except ValueError:
+            return None
+        return cand if isinstance(cand, dict) else None
+
+    def try_read(self) -> Optional[Dict[str, Any]]:
+        """One reply if already buffered, else None — never blocks."""
+        while True:
+            try:
+                line = self.q.get_nowait()
+            except queue.Empty:
+                return None
+            resp = self._parse(line)
+            if resp is not None:
+                return resp
+            if line is None:
+                return None
+
+    def read(self, timeout: float) -> Optional[Dict[str, Any]]:
+        deadline = time.time() + timeout
+        while True:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                return None
+            try:
+                line = self.q.get(timeout=remaining)
+            except queue.Empty:
+                return None
+            if line is None:
+                return None
+            resp = self._parse(line)
+            if resp is not None:
+                return resp
+
+    def wait_ready(self, timeout: float = READY_TIMEOUT_S) -> bool:
+        resp = self.read(timeout)
+        return bool(resp) and resp.get("op") == "ready"
+
+    def send(self, req: Dict[str, Any]) -> bool:
+        try:
+            self.proc.stdin.write(json.dumps(req) + "\n")
+            self.proc.stdin.flush()
+            return True
+        except (BrokenPipeError, OSError):
+            return False
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def kill(self) -> None:
+        try:
+            os.kill(self.proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+
+    def close(self, kill: bool = False) -> None:
+        try:
+            if kill:
+                self.proc.kill()
+            else:
+                self.send({"op": "quit"})
+                self.proc.stdin.close()
+            self.proc.wait(timeout=10)
+        except Exception:  # noqa: BLE001
+            try:
+                self.proc.kill()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def build_tasks(
+    grids: Sequence[Dict[str, Any]],
+    *,
+    chunk_steps: int,
+    results_root: str,
+    cache_dir: Optional[str],
+    resume: bool,
+    registry=None,
+) -> List[BucketTask]:
+    """Signature-key every shape bucket of every grid into `BucketTask`s.
+
+    Each grid dict: {"name", "points", and optionally "planet_dataset"
+    (None -> Planet.new()), "process_regions", "client_regions",
+    "gc_interval_ms", "extra_ms", "max_steps", "pool_slots"}. Signatures
+    are derived trace-only in THIS process and memoized on the bucket's
+    shape identity — `bucket_exec_signature` is a deterministic function
+    of (bucket key, batch size, chunk_steps, client-region count), so
+    joint grids whose placements/seeds differ only as Env data share one
+    trace here exactly as they share one executable on the fleet."""
+    from ..core.planet import Planet
+    from ..exp import harness
+
+    planets: Dict[Any, Any] = {}
+    sig_memo: Dict[Any, str] = {}
+    tasks: List[BucketTask] = []
+    for g in grids:
+        dataset = g.get("planet_dataset")
+        if dataset not in planets:
+            planets[dataset] = (
+                Planet.from_dataset(dataset) if dataset else Planet.new()
+            )
+        planet = planets[dataset]
+        client_regions = list(g.get("client_regions")
+                              or ["us-west1", "us-west2"])
+        common = dict(
+            planet_dataset=dataset,
+            process_regions=g.get("process_regions"),
+            client_regions=client_regions,
+            gc_interval_ms=g.get("gc_interval_ms", 50),
+            extra_ms=g.get("extra_ms", 2000),
+            max_steps=g.get("max_steps", 50_000_000),
+            pool_slots=g.get("pool_slots"),
+        )
+        # every request carries the WHOLE grid's points + the global
+        # bucket index: the worker's `run_grid(only_buckets=[bi])` then
+        # re-derives the same sorted bucket list and runs exactly one
+        # bucket under its full-grid index — dir names and resume
+        # fingerprints match a serial run of the grid by construction
+        # (sending only the bucket's own points would re-bucket them to
+        # index 0 and run nothing)
+        all_points = [harness.point_to_dict(pt) for pt in g["points"]]
+        for bi, bpoints in enumerate(harness.grid_buckets(g["points"])):
+            pt0 = bpoints[0]
+            memo_key = (
+                harness._bucket_key(pt0), len(bpoints), chunk_steps,
+                len(client_regions), common["gc_interval_ms"],
+                common["extra_ms"], common["max_steps"],
+                common["pool_slots"],
+            )
+            sig = sig_memo.get(memo_key)
+            if sig is None:
+                t0 = time.perf_counter()
+                sig = harness.bucket_exec_signature(
+                    bpoints, chunk_steps,
+                    planet=planet,
+                    process_regions=common["process_regions"],
+                    client_regions=client_regions,
+                    gc_interval_ms=common["gc_interval_ms"],
+                    extra_ms=common["extra_ms"],
+                    max_steps=common["max_steps"],
+                    pool_slots=common["pool_slots"],
+                )
+                sig_memo[memo_key] = sig
+                if registry is not None:
+                    registry.record_span(
+                        "fleet.signature", time.perf_counter() - t0,
+                        protocol=pt0.protocol, n=pt0.n,
+                    )
+            payload = dict(
+                common,
+                op="run",
+                points=all_points,
+                n_bucket_points=len(bpoints),
+                results_root=results_root,
+                name=g["name"],
+                bucket_index=bi,
+                chunk_steps=chunk_steps,
+                cache_dir=cache_dir,
+                resume=resume,
+            )
+            tasks.append(BucketTask(
+                bucket_id=f"{g['name']}:b{bi}",
+                signature=sig,
+                # relative sim weight: configs x commands x processes
+                cost=float(len(bpoints) * pt0.commands_per_client * pt0.n),
+                payload=payload,
+            ))
+    return tasks
+
+
+def run_fleet(
+    grids: Sequence[Dict[str, Any]],
+    *,
+    workers: int = 2,
+    results_root: str = "results",
+    chunk_steps: int = 1500,
+    cache_dir: Optional[str] = None,
+    resume: bool = False,
+    registry=None,
+    metrics_out: Optional[str] = None,
+    metrics_interval_s: float = 10.0,
+    kill_after_done: Optional[int] = None,
+    bucket_budget_s: float = 3600.0,
+    figures_out: Optional[str] = None,
+    verbose: bool = False,
+) -> Dict[str, Any]:
+    """Run every grid through a pool of `workers` worker processes,
+    compile-once fleet-wide; returns the run report.
+
+    `cache_dir` is the SHARED AOT store all workers publish/load through —
+    without it every worker compiles privately and the compile-once
+    invariant is vacuous, so the report marks `compile_once: None`.
+    `kill_after_done` SIGKILLs one busy worker after that many bucket
+    completions (the chaos hook CI's fleet-smoke uses); the victim's
+    buckets requeue and its replacement resumes/warm-starts.
+    `bucket_budget_s` bounds one bucket dispatch; a worker that blows it
+    is killed and treated as a death (its buckets requeue)."""
+    from ..telemetry import NULL_REGISTRY, MetricsRegistry, TextfileExporter
+
+    reg = registry
+    exporter = None
+    if metrics_out:
+        if reg is None:
+            reg = MetricsRegistry()
+        exporter = TextfileExporter(
+            reg, metrics_out, interval_s=metrics_interval_s,
+            jsonl_path=metrics_out + ".jsonl",
+        )
+    if reg is None:
+        reg = NULL_REGISTRY
+
+    t_start = time.perf_counter()
+    tasks = build_tasks(
+        grids, chunk_steps=chunk_steps, results_root=results_root,
+        cache_dir=cache_dir, resume=resume,
+        registry=None if reg is NULL_REGISTRY else reg,
+    )
+    sched = FleetScheduler(tasks)
+    distinct_sigs = len(sched.signatures())
+    reg.gauge("fleet_workers").set(workers)
+    reg.gauge("fleet_buckets").set(len(tasks))
+    reg.gauge("fleet_signatures").set(distinct_sigs)
+    if verbose:
+        print(f"fleet: {len(tasks)} buckets / {distinct_sigs} signatures"
+              f" across {workers} worker(s)", file=sys.stderr, flush=True)
+
+    cold_store = True
+    if cache_dir:
+        try:
+            cold_store = not any(
+                f.endswith(".exe") for f in os.listdir(cache_dir)
+            )
+        except OSError:
+            cold_store = True
+
+    pool: Dict[str, _WorkerProc] = {}
+    for i in range(workers):
+        pool[f"w{i}"] = _WorkerProc(f"w{i}")
+    for name, w in pool.items():
+        if not w.wait_ready():
+            w.close(kill=True)
+            raise RuntimeError(f"fleet worker {name} failed to start")
+
+    busy: Dict[str, Dict[str, Any]] = {}  # name -> {claim, t0}
+    attempts: Dict[str, int] = {}
+    replies: List[Dict[str, Any]] = []
+    bucket_events: Dict[str, List[Dict[str, Any]]] = {}
+    dirs: List[str] = []
+    skipped = 0
+    deaths = 0
+    kills_sent = 0
+    completed = 0
+
+    def dispatch(name: str, w: _WorkerProc, claim: Claim) -> None:
+        nonlocal deaths
+        req = dict(claim.task.payload)
+        req["bucket_id"] = claim.task.bucket_id
+        if claim.task.bucket_id in sched.requeued_ids:
+            # a requeued bucket may have published its results dir right
+            # before its worker died: resume skips it instead of
+            # re-running (atomic publish makes the dir trustworthy)
+            req["resume"] = True
+        attempts[claim.task.bucket_id] = \
+            attempts.get(claim.task.bucket_id, 0) + 1
+        if attempts[claim.task.bucket_id] > MAX_BUCKET_ATTEMPTS:
+            raise RuntimeError(
+                f"fleet: bucket {claim.task.bucket_id} failed"
+                f" {MAX_BUCKET_ATTEMPTS} attempts"
+            )
+        if not w.send(req):
+            # death detected at dispatch: requeue and let the main loop
+            # respawn the process
+            sched.worker_died(name)
+            return
+        busy[name] = {"claim": claim, "t0": time.time()}
+        if verbose:
+            role = "compile" if claim.compile else "sim"
+            print(f"fleet: {name} <- {claim.task.bucket_id} [{role}]",
+                  file=sys.stderr, flush=True)
+
+    def handle_death(name: str) -> None:
+        nonlocal deaths
+        deaths += 1
+        reg.counter("fleet_worker_deaths_total").inc()
+        requeued = sched.worker_died(name)
+        if requeued:
+            reg.counter("fleet_requeues_total").inc(len(requeued))
+        busy.pop(name, None)
+        pool[name].close(kill=True)
+        pool[name] = _WorkerProc(name)
+        if not pool[name].wait_ready():
+            pool[name].close(kill=True)
+            raise RuntimeError(f"fleet worker {name} failed to respawn")
+        if verbose:
+            print(f"fleet: {name} died, requeued {requeued}, respawned",
+                  file=sys.stderr, flush=True)
+
+    def handle_reply(name: str, resp: Dict[str, Any]) -> None:
+        nonlocal completed, skipped
+        entry = busy.pop(name)
+        claim: Claim = entry["claim"]
+        bid = claim.task.bucket_id
+        wall = time.time() - entry["t0"]
+        if resp.get("bucket_id") != bid:
+            # a stale line from a previous incarnation — treat as failure
+            sched.mark_failed(name, bid)
+            return
+        if not resp.get("ok") or \
+                (not resp.get("dirs") and not resp.get("skipped")):
+            # a reply with neither results nor a resume skip means the
+            # bucket ran NOTHING (e.g. an index mismatch) — completing it
+            # would silently drop its configs, so requeue instead
+            reg.counter("fleet_bucket_errors_total").inc()
+            sched.mark_failed(name, bid)
+            if verbose:
+                print(f"fleet: {name} {bid} FAILED: "
+                      f"{resp.get('err', 'empty run')}",
+                      file=sys.stderr, flush=True)
+            return
+        sched.mark_done(name, bid)
+        completed += 1
+        replies.append({"worker": name, "bucket_id": bid,
+                        "compile": claim.compile, **resp})
+        dirs.extend(resp.get("dirs", []))
+        skipped += int(resp.get("skipped", 0))
+        events = resp.get("cache_events", [])
+        bucket_events[bid] = bucket_events.get(bid, []) + events
+        role = "compile" if claim.compile else "sim"
+        reg.record_span("fleet.dispatch", wall, worker=name, bucket=bid,
+                        role=role)
+        compile_s = sum(e.get("compile_s", 0.0) for e in events
+                        if not e.get("hit"))
+        if compile_s:
+            reg.record_span("fleet.compile", compile_s, worker=name,
+                            bucket=bid)
+        for e in events:
+            if e.get("hit"):
+                reg.counter("fleet_cache_hits_total").inc()
+            else:
+                reg.counter("fleet_compile_misses_total").inc()
+        reg.counter("fleet_buckets_done_total").inc()
+        if verbose:
+            print(f"fleet: {name} -> {bid} done ({wall:.1f}s,"
+                  f" {len(events)} cache events)",
+                  file=sys.stderr, flush=True)
+
+    try:
+        while not sched.done():
+            progressed = False
+            # chaos hook: after `kill_after_done` completions, SIGKILL one
+            # busy worker exactly once — the fleet must finish anyway
+            if (kill_after_done is not None and kills_sent == 0
+                    and completed >= kill_after_done and busy):
+                victim = sorted(busy)[0]
+                pool[victim].kill()
+                kills_sent += 1
+                if verbose:
+                    print(f"fleet: chaos SIGKILL -> {victim}",
+                          file=sys.stderr, flush=True)
+            # deaths + reply drain
+            for name in list(pool):
+                w = pool[name]
+                resp = w.try_read()
+                if resp is not None and name in busy:
+                    handle_reply(name, resp)
+                    progressed = True
+                    continue
+                if not w.alive():
+                    handle_death(name)
+                    progressed = True
+                elif name in busy and \
+                        time.time() - busy[name]["t0"] > bucket_budget_s:
+                    w.kill()  # over budget: next poll sees the death
+            # fill idle workers
+            for name in sorted(pool):
+                if name in busy:
+                    continue
+                claim = sched.next_for(name)
+                if claim is None:
+                    continue
+                dispatch(name, pool[name], claim)
+                progressed = True
+            if sched.done():
+                break
+            if not busy and sched.pending() and not progressed:
+                raise PlanError(
+                    "fleet stalled: pending buckets but no dispatchable"
+                    f" work and no worker busy ({sched.snapshot()})"
+                )
+            if exporter is not None:
+                exporter.maybe_write()
+            if not progressed:
+                time.sleep(0.05)
+    finally:
+        for w in pool.values():
+            w.close()
+
+    wall_s = time.perf_counter() - t_start
+
+    # -- compile-once audit over the workers' cache-event receipts ----------
+    all_events = [e for evs in bucket_events.values() for e in evs]
+    mega_misses = [e for e in all_events
+                   if e.get("program") == "sweep.megachunk"
+                   and not e.get("hit")]
+    miss_keys: Dict[str, int] = {}
+    for e in all_events:
+        if not e.get("hit"):
+            miss_keys[e["key"]] = miss_keys.get(e["key"], 0) + 1
+    hits = sum(1 for e in all_events if e.get("hit"))
+    requeued_warm_hits = sum(
+        1 for bid in set(sched.requeued_ids)
+        for e in bucket_events.get(bid, []) if e.get("hit")
+    )
+    no_key_missed_twice = all(c == 1 for c in miss_keys.values())
+    compile_once: Optional[bool] = None
+    compile_once_exact: Optional[bool] = None
+    if cache_dir:
+        # the invariant "each distinct program compiled exactly once
+        # fleet-wide" == one megachunk miss per distinct signature. The
+        # strict equality is only CHECKABLE on a clean cold no-resume run:
+        # a killed worker's in-flight miss events die with its reply, a
+        # resume skip runs nothing, and a pre-warmed store compiles
+        # nothing — those runs still assert the one-sided bounds (no key
+        # missed twice; misses never exceed distinct signatures).
+        compile_once = (no_key_missed_twice
+                        and len(mega_misses) <= distinct_sigs)
+        if deaths == 0 and skipped == 0 and cold_store and not resume:
+            compile_once_exact = len(mega_misses) == distinct_sigs
+    report: Dict[str, Any] = {
+        "buckets": len(tasks),
+        "distinct_signatures": distinct_sigs,
+        "fleet_compile_misses": len(mega_misses),
+        "cache_hits": hits,
+        "workers": workers,
+        "worker_deaths": deaths,
+        "requeues": sched.requeues,
+        "requeued_buckets": sorted(set(sched.requeued_ids)),
+        "requeued_warm_hits": requeued_warm_hits,
+        "skipped": skipped,
+        "completed": completed,
+        "dirs": dirs,
+        "wall_s": round(wall_s, 3),
+        "configs": sum(t.payload["n_bucket_points"] for t in tasks),
+        "compile_once": compile_once,
+        "compile_once_exact": compile_once_exact,
+        "cold_store": cold_store,
+        "per_worker": {
+            name: {
+                "buckets": sum(1 for r in replies if r["worker"] == name),
+                "wall_s": round(sum(r.get("wall_s", 0.0) for r in replies
+                                    if r["worker"] == name), 3),
+            }
+            for name in pool
+        },
+    }
+    if figures_out:
+        from ..plot.plots import eurosys_figures
+
+        report["figures"] = eurosys_figures(results_root, figures_out)
+    if exporter is not None:
+        exporter.write()
+    return report

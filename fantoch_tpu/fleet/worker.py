@@ -1,0 +1,113 @@
+"""Fleet worker: one persistent process serving bucket runs over line-JSON.
+
+The process-side half of the fleet scheduler, shaped like `bench.py`'s
+warm worker (`worker_main`): initialize JAX ONCE, print a `ready`
+handshake, then serve one op per stdin line until EOF, replying one JSON
+line per op on stdout (stderr passes through for logs). Keeping the
+process alive across buckets is what amortizes JAX init, and routing
+every compile through the SHARED `ExecutableStore` is what lets the
+parent's claim machine guarantee compile-once fleet-wide: a bucket
+dispatched against a warm signature deserializes instead of compiling,
+and the reply's drained cache events are the receipts the parent audits.
+
+Ops:
+  {"op": "run", ...payload}  -> run one shape bucket via
+      `run_grid(..., only_buckets=[bucket_index])`; reply carries dirs,
+      skipped count, the store's per-bucket cache events + stats and wall
+      time.
+  {"op": "quit"}             -> clean exit.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, Dict
+
+
+def _build_planet(dataset):
+    from ..core.planet import Planet
+
+    if dataset:
+        return Planet.from_dataset(dataset)
+    return Planet.new()
+
+
+def _run_op(req: Dict[str, Any], store_cache: Dict[str, Any]) -> Dict[str, Any]:
+    from ..cache.store import ExecutableStore
+    from ..exp import harness
+
+    points = [harness.point_from_dict(d) for d in req["points"]]
+    cache = None
+    cache_dir = req.get("cache_dir")
+    if cache_dir:
+        # one store handle per directory for the process lifetime — its
+        # in-memory unserializable-key set and counters stay warm across
+        # buckets; events are drained per op so each reply carries only
+        # its own bucket's resolutions
+        cache = store_cache.get(cache_dir)
+        if cache is None:
+            cache = store_cache.setdefault(cache_dir, ExecutableStore(cache_dir))
+        cache.drain_events()
+    stats: Dict[str, int] = {}
+    t0 = time.perf_counter()
+    dirs = harness.run_grid(
+        points,
+        planet=_build_planet(req.get("planet_dataset")),
+        process_regions=req.get("process_regions"),
+        client_regions=req.get("client_regions"),
+        results_root=req["results_root"],
+        name=req["name"],
+        chunk_steps=req.get("chunk_steps"),
+        gc_interval_ms=req.get("gc_interval_ms", 50),
+        extra_ms=req.get("extra_ms", 2000),
+        max_steps=req.get("max_steps", 50_000_000),
+        pool_slots=req.get("pool_slots"),
+        resume=bool(req.get("resume")),
+        stats=stats,
+        cache=cache,
+        only_buckets=[int(req["bucket_index"])],
+    )
+    resp: Dict[str, Any] = {
+        "ok": True,
+        "dirs": dirs,
+        "skipped": stats.get("skipped", 0),
+        "wall_s": round(time.perf_counter() - t0, 3),
+    }
+    if cache is not None:
+        resp["cache_events"] = cache.drain_events()
+        resp["cache_stats"] = cache.stats()
+    return resp
+
+
+def worker_main() -> int:
+    """Serve fleet ops from stdin until EOF. The ready line carries the
+    backend so the parent can log what the fleet actually runs on."""
+    import jax
+
+    backend = jax.default_backend()  # JAX init happens here, once
+    print(json.dumps({"op": "ready", "backend": backend,
+                      "pid": os.getpid()}), flush=True)
+    store_cache: Dict[str, Any] = {}
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            req = json.loads(line)
+        except ValueError:
+            continue
+        op = req.get("op")
+        if op == "quit":
+            break
+        resp: Dict[str, Any] = {"op": op, "bucket_id": req.get("bucket_id")}
+        try:
+            if op == "run":
+                resp.update(_run_op(req, store_cache))
+            else:
+                resp.update(ok=False, err=f"unknown op {op!r}")
+        except Exception as e:  # noqa: BLE001 — soft faults stay contained
+            resp.update(ok=False, err=f"{type(e).__name__}: {e}"[:500])
+        print(json.dumps(resp), flush=True)
+    return 0

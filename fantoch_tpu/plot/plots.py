@@ -607,3 +607,46 @@ def batching_plot(
     fig.savefig(output, bbox_inches="tight", dpi=150)
     plt.close(fig)
     return output
+
+
+def eurosys_figures(results_root: str, out_dir: str) -> List[str]:
+    """The EuroSys'21 headline figure set from a results root: latency
+    CDF, throughput/latency frontier per protocol, and — when the grid
+    swept the matching axes — fast-path-vs-conflict and the NFR
+    read-only comparison. The end-of-run artifact a fleet sweep emits
+    (`fantoch_tpu fleet`, `tools/northstar.py`), sharing the renderers
+    with `python -m fantoch_tpu plot`. Returns the created paths
+    (empty when the root holds no results)."""
+    import os
+
+    from .db import ResultsDB
+
+    db = ResultsDB.load(results_root)
+    if not len(db):
+        return []
+    os.makedirs(out_dir, exist_ok=True)
+    protos = sorted({e.search.get("protocol") for e in db})
+    series = {p: db.find(protocol=p) for p in protos}
+    made = [
+        cdf_plot(list(db), os.path.join(out_dir, "cdf.png")),
+        throughput_latency_plot(
+            series, os.path.join(out_dir, "throughput_latency.png")
+        ),
+        throughput_latency_plot(
+            series, os.path.join(out_dir, "throughput_p99.png"),
+            latency="p99",
+        ),
+    ]
+    if len({e.search.get("conflict") for e in db
+            if "conflict" in e.search}) > 1:
+        made.append(fast_path_plot(
+            series, "conflict", os.path.join(out_dir, "fast_path.png")
+        ))
+    ro_values = {
+        e.search["read_only_percentage"]
+        for e in db
+        if "read_only_percentage" in e.search
+    }
+    if len(ro_values) > 1:
+        made.append(nfr_plot(series, os.path.join(out_dir, "nfr.png")))
+    return made

@@ -335,6 +335,15 @@ class MetricsRegistry:
             return _NULL_SPAN
         return _Span(self, stage, meta)
 
+    def record_span(self, stage: str, dur_s: float, **meta) -> None:
+        """Record an externally-timed span — a completed unit whose wall
+        time was measured outside a `with` block (e.g. a fleet worker's
+        round trip, timed by the parent's dispatch loop). Feeds the same
+        ring + per-stage counter/histogram as `span()`."""
+        if not self.enabled:
+            return
+        self._record_span(stage, float(dur_s), meta)
+
     def _record_span(self, stage: str, dur_s: float, meta) -> None:
         dur_us = int(dur_s * 1e6)
         stats = self._span_stats.get(stage)

@@ -1,0 +1,174 @@
+"""Fleet plan unit tests (fantoch_tpu/fleet/plan) — pure host, NO jax.
+
+The contract under test:
+
+1. **Signature grouping + deterministic plan**: a fixed grid always
+   yields the same dispatch order — signature groups by total cost
+   (LPT), buckets within a group by cost then id.
+2. **Compile-once interleaving**: at most one worker per signature ever
+   holds a compile claim; siblings of a compiling signature are DEFERRED
+   (never dispatched to a second worker) while warm/unclaimed work
+   flows; once the compiler finishes, deferred siblings dispatch warm.
+3. **No bucket claimed twice**: a claimed/done bucket is never handed
+   out again, and completion by a non-owner raises.
+4. **Dead-worker requeue**: a death requeues exactly the worker's
+   claimed buckets and reverts its compiling signatures to unclaimed, so
+   the work is re-claimable (and the compile inheritable) by survivors.
+"""
+import random
+import sys
+
+import pytest
+
+from fantoch_tpu.fleet.plan import (
+    COMPILING,
+    UNCLAIMED,
+    WARM,
+    BucketTask,
+    FleetScheduler,
+    PlanError,
+    build_plan,
+)
+
+
+def test_plan_module_has_no_jax_dependency():
+    assert "jax" not in sys.modules.get("fantoch_tpu.fleet.plan").__dict__
+    # the package import surface must stay lazy too
+    import fantoch_tpu.fleet  # noqa: F401
+
+
+def _grid():
+    # two signatures, heterogeneous costs: sig B's group outweighs A's
+    return [
+        BucketTask("g:b0", "sigA", cost=10.0),
+        BucketTask("g:b1", "sigB", cost=30.0),
+        BucketTask("g:b2", "sigA", cost=5.0),
+        BucketTask("g:b3", "sigB", cost=1.0),
+        BucketTask("h:b0", "sigB", cost=2.0),
+    ]
+
+
+def test_build_plan_groups_by_signature_and_is_deterministic():
+    plan1 = build_plan(_grid())
+    plan2 = build_plan(list(reversed(_grid())))
+    # deterministic regardless of input order
+    assert [t.bucket_id for t in plan1] == [t.bucket_id for t in plan2]
+    # sigB group (total 33) precedes sigA (total 15); within a group
+    # cost-desc then id
+    assert [t.bucket_id for t in plan1] == \
+        ["g:b1", "h:b0", "g:b3", "g:b0", "g:b2"]
+    # grouping: each signature's buckets are contiguous
+    sigs = [t.signature for t in plan1]
+    assert sigs == sorted(sigs, key=sigs.index)
+
+
+def test_duplicate_bucket_ids_rejected():
+    with pytest.raises(PlanError):
+        FleetScheduler([BucketTask("x", "s"), BucketTask("x", "s")])
+
+
+def test_compile_once_interleaving():
+    s = FleetScheduler(_grid())
+    c1 = s.next_for("w0")
+    assert c1.compile and c1.task.signature == "sigB"
+    # w1 must NOT get another sigB bucket while w0 compiles it — it gets
+    # the other signature's compile claim instead
+    c2 = s.next_for("w1")
+    assert c2.compile and c2.task.signature == "sigA"
+    # both signatures compiling -> a third worker is deferred
+    assert s.next_for("w2") is None
+    # compiler finishes: deferred sigB siblings dispatch WARM
+    s.mark_done("w0", c1.task.bucket_id)
+    c3 = s.next_for("w2")
+    assert c3 is not None and not c3.compile
+    assert c3.task.signature == "sigB"
+    # at most one compile claim per signature over the whole run
+    compile_claims = [c1, c2]
+    assert len({c.task.signature for c in compile_claims}) == 2
+
+
+def test_warm_work_preferred_over_new_compile():
+    s = FleetScheduler(_grid())
+    c1 = s.next_for("w0")
+    s.mark_done("w0", c1.task.bucket_id)  # sigB now warm
+    # next claim takes a warm sigB bucket, not the sigA compile
+    c2 = s.next_for("w0")
+    assert not c2.compile and c2.task.signature == "sigB"
+
+
+def test_no_bucket_claimed_twice_and_owner_checked():
+    s = FleetScheduler(_grid())
+    seen = set()
+    claims = []
+    while True:
+        c = s.next_for(f"w{len(claims)}")
+        if c is None:
+            break
+        assert c.task.bucket_id not in seen
+        seen.add(c.task.bucket_id)
+        claims.append(c)
+    # completion by a non-owner is an invariant violation
+    with pytest.raises(PlanError):
+        s.mark_done("imposter", claims[0].task.bucket_id)
+    # double completion too
+    s.mark_done("w0", claims[0].task.bucket_id)
+    with pytest.raises(PlanError):
+        s.mark_done("w0", claims[0].task.bucket_id)
+
+
+def test_dead_worker_requeue_reverts_compile_and_work_resumes():
+    s = FleetScheduler(_grid())
+    c1 = s.next_for("w0")  # sigB compile
+    c2 = s.next_for("w1")  # sigA compile
+    assert s.next_for("w2") is None
+    requeued = s.worker_died("w0")
+    assert requeued == [c1.task.bucket_id]
+    assert s.requeues == 1
+    # sigB reverted: w2 can now inherit the compile
+    c3 = s.next_for("w2")
+    assert c3.compile and c3.task.signature == "sigB"
+    # w1 unaffected
+    s.mark_done("w1", c2.task.bucket_id)
+    # a death with nothing claimed requeues nothing
+    assert s.worker_died("w0") == []
+
+
+def test_full_run_drains_under_random_schedules():
+    # property check: random interleavings of claim/complete/die always
+    # drain the plan with every bucket done exactly once and never two
+    # concurrent claims on one signature's compile
+    rng = random.Random(7)
+    for trial in range(25):
+        tasks = [
+            BucketTask(f"g:b{i}", f"sig{i % 3}", cost=float(1 + i % 5))
+            for i in range(9)
+        ]
+        s = FleetScheduler(tasks)
+        busy = {}
+        completions = 0
+        for _ in range(10_000):
+            if s.done():
+                break
+            action = rng.random()
+            free = [w for w in ("w0", "w1", "w2") if w not in busy]
+            if action < 0.5 and free:
+                w = rng.choice(free)
+                c = s.next_for(w)
+                if c is not None:
+                    busy[w] = c
+                    # invariant: one compiling owner per signature
+                    sigs = [cl.task.signature for cl in busy.values()
+                            if cl.compile]
+                    assert len(sigs) == len(set(sigs))
+            elif action < 0.9 and busy:
+                w = rng.choice(sorted(busy))
+                s.mark_done(w, busy.pop(w).task.bucket_id)
+                completions += 1
+            elif busy:
+                w = rng.choice(sorted(busy))
+                busy.pop(w)
+                s.worker_died(w)
+        assert s.done(), f"trial {trial} did not drain"
+        # each bucket completes exactly once: done buckets never requeue
+        # (only claimed-at-death ones do, and those had not completed)
+        assert completions == 9

@@ -66,6 +66,14 @@ def main(argv=None) -> int:
     ap.add_argument("--resume", action="store_true",
                     help="skip shape buckets whose results already landed"
                          " (segment-safe restarts on the flaky tunnel)")
+    ap.add_argument("--fleet", type=int, default=2,
+                    help="milestone worker-pool size: milestones route"
+                         " through the fleet scheduler (fantoch_tpu/fleet),"
+                         " compile-once across placements via the shared"
+                         " AOT store")
+    ap.add_argument("--metrics-out", default="",
+                    help="milestones: Prometheus textfile of the fleet"
+                         " telemetry (.jsonl snapshots beside it)")
     args = ap.parse_args(argv)
 
     import jax
@@ -167,14 +175,19 @@ GCP20 = None  # filled lazily: all regions of the GCP latency dataset
 
 
 def _milestone_grids(args):
-    """The five BASELINE.json milestone configurations at real shapes."""
+    """The five BASELINE.json milestone configurations at real shapes.
+
+    Each milestone maps to a list of `(planet_dataset, regions, points)`
+    batches — the dataset NAME (a `Planet.from_dataset` argument), not a
+    Planet object, so a batch serializes straight into a fleet worker
+    request."""
     from fantoch_tpu.core.planet import Planet
     from fantoch_tpu.exp.harness import Point
 
-    gcp = Planet.new()
-    gcp_regions = list(gcp.regions())
-    aws = Planet.from_dataset("aws_2021_02_13")
-    aws_regions = list(aws.regions())
+    gcp = "gcp"
+    gcp_regions = list(Planet.new().regions())
+    aws = "aws_2021_02_13"
+    aws_regions = list(Planet.from_dataset(aws).regions())
 
     def pts(proto, n, f, conflicts, seeds, clients=(2,), cmds=20, seed0=0,
             **kw):
@@ -233,7 +246,14 @@ def _milestone_grids(args):
 
 
 def run_milestones(args) -> int:
-    from fantoch_tpu.exp.harness import run_grid
+    """Milestones route through the fleet scheduler: every batch of a
+    milestone becomes a fleet grid (names/bucket indices — and therefore
+    results dirs and resume fingerprints — exactly what the retired
+    serial `run_grid` loop produced, so existing partial results are not
+    orphaned), and each distinct program compiles once ACROSS batches
+    (joint-10k's three placements share shape buckets, so they share
+    executables fleet-wide)."""
+    from fantoch_tpu.fleet.scheduler import run_fleet
     from fantoch_tpu.plot.db import ResultsDB
     from fantoch_tpu.plot import plots
 
@@ -244,23 +264,29 @@ def run_milestones(args) -> int:
         batches = grids[name]
         results_root = os.path.join(args.out, name)
         total = sum(len(b[2]) for b in batches)
-        t0 = time.time()
-        skipped_buckets = 0
-        for bi, (planet, regions, points) in enumerate(batches):
+        fleet_grids = []
+        for bi, (dataset, regions, points) in enumerate(batches):
             nmax = max(pt.n for pt in points)
-            stats = {}
-            run_grid(
-                points,
-                planet=planet,
-                process_regions=regions[:nmax],
-                client_regions=[regions[0], regions[-1]],
-                results_root=results_root,
-                name=f"{name}_{bi}",
-                chunk_steps=args.chunk_steps,
-                resume=args.resume,
-                stats=stats,
-            )
-            skipped_buckets += stats.get("skipped", 0)
+            fleet_grids.append({
+                "name": f"{name}_{bi}",
+                "points": points,
+                "planet_dataset": None if dataset == "gcp" else dataset,
+                "process_regions": regions[:nmax],
+                "client_regions": [regions[0], regions[-1]],
+            })
+        cache_dir = os.path.join(args.out, ".aot_cache")
+        os.makedirs(cache_dir, exist_ok=True)
+        t0 = time.time()
+        report = run_fleet(
+            fleet_grids,
+            workers=max(1, args.fleet),
+            results_root=results_root,
+            chunk_steps=args.chunk_steps,
+            cache_dir=cache_dir,
+            resume=args.resume,
+            metrics_out=args.metrics_out or None,
+            verbose=True,
+        )
         wall = time.time() - t0
         db = ResultsDB.load(results_root)
         figdir = os.path.join(args.out, "figures")
@@ -275,15 +301,25 @@ def run_milestones(args) -> int:
             "wall_s": round(wall, 1),
             "configs_per_hour": round(total / max(wall, 1e-9) * 3600.0, 1),
             "figure": fig,
+            "fleet": {k: report[k] for k in (
+                "workers", "buckets", "distinct_signatures",
+                "fleet_compile_misses", "cache_hits", "worker_deaths",
+                "requeues", "compile_once", "compile_once_exact",
+            )},
         }
-        if skipped_buckets:
+        if report["skipped"]:
             # part of the grid came from a previous invocation's results:
             # the pace above is NOT a fresh-throughput measurement
-            results[name]["resumed_buckets"] = skipped_buckets
+            results[name]["resumed_buckets"] = report["skipped"]
             results[name]["pace_comparable"] = False
         print(json.dumps({"milestone": name, **results[name]}))
     print(json.dumps({"milestones": results}))
-    return 0
+    # the compile-once audit is the fleet's contract: surface a violation
+    # as a nonzero exit so milestone automation can gate on it
+    bad = [n for n in results
+           if results[n]["fleet"]["compile_once"] is False
+           or results[n]["fleet"]["compile_once_exact"] is False]
+    return 1 if bad else 0
 
 
 if __name__ == "__main__":
