@@ -503,6 +503,19 @@ def cmd_lint(args) -> int:
             return 2
         variants[flag] = tuple("on" == v for v in vals)
 
+    # rule-family selection: bare `lint` runs everything; any family flag
+    # narrows the run to exactly the named families (so CI can run a
+    # trace-free `--host-sync` pass, or `--memory` alone)
+    families = None
+    selected = [
+        fam for fam, on in (
+            ("base", args.base), ("memory", args.memory),
+            ("host-sync", args.host_sync), ("headroom", args.headroom),
+        ) if on
+    ]
+    if selected:
+        families = selected
+
     aot_store = None
     if args.aot_alias:
         # the executable-alias verification compiles; route it through the
@@ -521,25 +534,30 @@ def cmd_lint(args) -> int:
         verbose=args.verbose,
         aot_alias=args.aot_alias,
         aot_store=aot_store,
+        families=families,
     )
     if aot_store is not None:
         print(f"lint: aot store {aot_store.stats()}", file=sys.stderr)
     if args.update_budgets:
-        # re-baseline the HLO size budgets from THIS run's eqn counts
-        # (merging over the committed manifest so a partial-matrix run
-        # never drops budgets for programs it didn't trace), then drop the
-        # hlo-size findings — the update is the sanctioned re-baseline
-        from .analysis import rules as rules_mod
+        # re-baseline BOTH budget manifests (hlo_budgets.json +
+        # memory_budgets.json) from THIS run's counts/estimates —
+        # atomically (temp + rename per manifest) and with merge semantics
+        # (a partial-matrix run never drops budgets for programs it didn't
+        # trace) — then drop the hlo-size/memory findings: the update IS
+        # the sanctioned re-baseline
+        from .analysis import memory as memory_mod
 
-        budgets = dict(rules_mod.load_hlo_budgets())
-        budgets.update({p["name"]: p["eqns"] for p in report["programs"]})
-        path = rules_mod.save_hlo_budgets(budgets)
+        hlo_path, mem_path = memory_mod.update_budget_manifests(
+            report["programs"]
+        )
         report["violations"] = [
             v for v in report["violations"]
-            if not v["rule"].startswith("hlo-size")
+            if not (v["rule"].startswith("hlo-size")
+                    or v["rule"].startswith("memory"))
         ]
         report["ok"] = not report["violations"] and bool(report["programs"])
-        print(f"lint: budgets updated -> {path} ({len(budgets)} programs)",
+        print(f"lint: budgets updated -> {hlo_path} + {mem_path}"
+              f" ({len(report['programs'])} programs re-baselined)",
               file=sys.stderr)
     if args.json:
         print(json.dumps(report))
@@ -557,11 +575,13 @@ def cmd_lint(args) -> int:
             f" [{'OK' if report['ok'] else 'FAIL'}]",
             file=sys.stderr,
         )
-    if not report["programs"]:
+    if not report["programs"] and "host_sync" not in report:
         # every requested program was skipped (e.g. quantum on a
         # too-small device mesh): a run that statically checked NOTHING
         # must not exit green — the same vacuous-pass class as an empty
-        # variant CSV
+        # variant CSV. A host-sync-only run legitimately traces nothing
+        # (pure source analysis); its own vacuity guard is files > 0,
+        # folded into report["ok"] by checker.lint.
         print(f"lint: VACUOUS — 0 programs traced,"
               f" {len(report['skipped'])} skipped", file=sys.stderr)
         return 1
@@ -1187,7 +1207,8 @@ def main(argv=None) -> int:
     pl = sub.add_parser(
         "lint",
         help="static engine-contract checker: trace the jitted programs,"
-             " verify purity/dtype/donation/recompile-key rules"
+             " verify purity/dtype/donation/recompile-key/hlo-size/memory"
+             " rules, host-sync AST lint, dtype-headroom advisories"
              " (exit 1 on violation)",
     )
     pl.add_argument("--protocols", default="",
@@ -1208,9 +1229,29 @@ def main(argv=None) -> int:
     pl.add_argument("--aot-cache-dir", default="",
                     help="executable-store dir for --aot-alias"
                          " (default: the shared AOT cache root)")
+    pl.add_argument("--base", action="store_true",
+                    help="run the base rule family"
+                         " (purity/dtype/donation/static-keys/hlo-size);"
+                         " any family flag narrows the run to the named"
+                         " families — no flags runs everything")
+    pl.add_argument("--memory", action="store_true",
+                    help="run the memory rule family: donation-aware"
+                         " resident/peak byte estimates checked against"
+                         " analysis/memory_budgets.json")
+    pl.add_argument("--host-sync", dest="host_sync", action="store_true",
+                    help="run the host-sync AST lint over the serving/"
+                         "sweep/fleet hot paths (pure source analysis —"
+                         " traces nothing when selected alone)")
+    pl.add_argument("--headroom", action="store_true",
+                    help="run the dtype-headroom advisor: int32 state"
+                         " leaves that provably fit int16/int8 from"
+                         " SimSpec bounds (non-failing, --json"
+                         " 'advisories')")
     pl.add_argument("--update-budgets", action="store_true",
-                    help="re-baseline analysis/hlo_budgets.json from this"
-                         " run's eqn counts (the hlo-size escape hatch)")
+                    help="re-baseline analysis/hlo_budgets.json AND"
+                         " analysis/memory_budgets.json from this run"
+                         " (atomic, merge semantics — the hlo-size/memory"
+                         " escape hatch)")
     pl.add_argument("--json", action="store_true",
                     help="print the full JSON report on stdout")
     pl.add_argument("--verbose", action="store_true")

@@ -34,6 +34,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from . import rules as rules_mod
+from .memory import estimate_program
 from .rules import ALL_RULES, Leaf, Violation, jaxpr_signature
 
 PROTOCOLS = ("basic", "tempo", "atlas", "epaxos", "fpaxos", "caesar")
@@ -80,6 +81,13 @@ class Program:
     # directly; a fantoch_tpu.cache.ExecutableStore loads-or-compiles) —
     # the input of the executable-alias verification (@slow / --aot-alias)
     aot_fn: Optional[Callable[[Any], Any]] = None
+    # effect channels this program DECLARES (e.g. ("io_callback",)): the
+    # purity rule passes a sanctioned ordered io_callback but still fails
+    # an undeclared one under "purity/ordered-effect"
+    sanctioned_effects: Tuple[str, ...] = ()
+    # {"resident": bytes, "peak": bytes} — filled lazily by
+    # analysis.memory.estimate_program (the memory rule and the report)
+    memory: Optional[Dict[str, int]] = None
 
 
 def _keystr(kp) -> str:
@@ -111,6 +119,7 @@ def program_from_traced(
     key: Optional[Tuple] = None,
     retrace_fn=None,
     aot_fn=None,
+    sanctioned_effects: Tuple[str, ...] = (),
 ) -> Program:
     """Build a `Program` from a ``jax.jit(...).trace(...)`` result.
 
@@ -168,6 +177,7 @@ def program_from_traced(
         key=key if key is not None else (kind, protocol, repr(spec)),
         expect_donation=expect_donation, forbid_donation=forbid_donation,
         retrace_fn=retrace_fn, eqn_count=eqns, aot_fn=aot_fn,
+        sanctioned_effects=tuple(sanctioned_effects),
     )
 
 
@@ -484,7 +494,7 @@ def build_matrix(
 
 def run_check(programs: Sequence[Program], rules=ALL_RULES,
               retrace: bool = True, aot_alias: bool = False,
-              aot_store=None) -> Dict[str, Any]:
+              aot_store=None, advisors: Sequence[Any] = ()) -> Dict[str, Any]:
     """Apply the rule set to every program; returns the JSON-able report.
 
     Beyond the per-program rules, two cross-program recompile-hygiene
@@ -497,12 +507,20 @@ def run_check(programs: Sequence[Program], rules=ALL_RULES,
     an `aot_fn` (through `aot_store` — a fantoch_tpu.cache.ExecutableStore
     — when given, so re-lints deserialize instead of recompiling) and
     verifies the executable's actual input_output_aliases against the
-    static donation verdict (@slow tier / `lint --aot-alias`)."""
+    static donation verdict (@slow tier / `lint --aot-alias`).
+
+    `advisors` are like rules but NON-FAILING: each has an ``id`` and an
+    ``advise(program) -> [dict]`` method; findings land in the report's
+    "advisories" list (and never touch "ok") — the dtype-headroom advisor
+    rides here."""
     violations: List[Violation] = []
+    advisories: List[Dict[str, Any]] = []
     by_key: Dict[Tuple, Tuple[str, str]] = {}
     for p in programs:
         for rule in rules:
             violations.extend(rule.check(p))
+        for adv in advisors:
+            advisories.extend(adv.advise(p))
         if retrace and p.retrace_fn is not None:
             violations.extend(
                 rules_mod.check_trace_stability(p, p.retrace_fn())
@@ -530,6 +548,10 @@ def run_check(programs: Sequence[Program], rules=ALL_RULES,
                 "variant": p.variant,
                 "eqns": p.eqn_count,
                 "signature": p.signature,
+                # static resource estimate {"resident", "peak"} bytes —
+                # what the memory rule budgets and the fleet report can
+                # bin-pack on
+                "memory": estimate_program(p),
                 "donated_leaves": sum(1 for lf in p.args if lf.donated),
                 # state leaves the dtype-schema rule actually compared —
                 # 0 on a state-carrying program means the check went
@@ -541,13 +563,38 @@ def run_check(programs: Sequence[Program], rules=ALL_RULES,
             }
             for p in programs
         ],
-        "rules": [r.id for r in rules],
+        "rules": [r.id for r in rules] + [a.id for a in advisors],
         "violations": [v.to_dict() for v in violations],
+        # non-failing findings (dtype-headroom): never affect "ok"
+        "advisories": advisories,
         # a run that traced NOTHING (everything skipped) is vacuous, not
         # clean — `ok` in the machine-readable report must agree with the
         # CLI exit code, so --json consumers can trust it directly
         "ok": not violations and len(programs) > 0,
     }
+
+
+# rule families the CLI can toggle: "base" = the five PR-4/5 shape rules,
+# the other three are this layer's resource rules. families=None means all.
+LINT_FAMILIES = ("base", "memory", "host-sync", "headroom")
+
+
+def _family_rules(families) -> Tuple[Tuple[Any, ...], Tuple[Any, ...]]:
+    """(rules, advisors) for a family selection."""
+    from .headroom import HeadroomAdvisor
+    from .memory import MemoryRule
+
+    rules: List[Any] = []
+    if "base" in families:
+        rules += [
+            rules_mod.PurityRule(), rules_mod.DtypeRule(),
+            rules_mod.DonationRule(), rules_mod.StaticKeyRule(),
+            rules_mod.HloSizeRule(),
+        ]
+    if "memory" in families:
+        rules.append(MemoryRule())
+    advisors = (HeadroomAdvisor(),) if "headroom" in families else ()
+    return tuple(rules), advisors
 
 
 def lint(
@@ -559,13 +606,43 @@ def lint(
     verbose: bool = False,
     aot_alias: bool = False,
     aot_store=None,
+    families: Optional[Sequence[str]] = None,
 ) -> Dict[str, Any]:
-    """Trace the matrix, run every rule, return the report dict."""
-    programs, skips = build_matrix(
-        protocols, engines, trace_variants, fault_variants, verbose=verbose
-    )
-    report = run_check(programs, retrace=retrace, aot_alias=aot_alias,
-                       aot_store=aot_store)
+    """Trace the matrix, run the selected rule families, return the report
+    dict. `families=None` runs everything; a selection without any traced
+    family (e.g. ``["host-sync"]``) traces nothing — the host-sync lint is
+    pure source analysis and "ok" is then judged on files scanned, not
+    programs traced."""
+    fams = set(families) if families is not None else set(LINT_FAMILIES)
+    unknown = fams - set(LINT_FAMILIES)
+    if unknown:
+        raise ValueError(f"unknown lint families: {sorted(unknown)}")
+    rules, advisors = _family_rules(fams)
+    need_trace = bool(rules) or bool(advisors)
+    if need_trace:
+        programs, skips = build_matrix(
+            protocols, engines, trace_variants, fault_variants,
+            verbose=verbose,
+        )
+    else:
+        programs, skips = [], []
+    report = run_check(programs, rules=rules, retrace=retrace,
+                       aot_alias=aot_alias, aot_store=aot_store,
+                       advisors=advisors)
+    if "host-sync" in fams:
+        from . import hostsync
+
+        hs = hostsync.lint_paths()
+        report["violations"].extend(v.to_dict() for v in hs["violations"])
+        report["rules"].append("host-sync")
+        report["host_sync"] = {
+            "files": hs["files"],
+            "scopes": hs["scopes"],
+            "sanctioned": hs["sanctioned"],
+        }
+        traced_ok = len(report["programs"]) > 0 if need_trace else True
+        report["ok"] = (not report["violations"] and traced_ok
+                        and hs["files"] > 0)
     report["skipped"] = skips
     report["matrix"] = {
         "protocols": list(protocols),

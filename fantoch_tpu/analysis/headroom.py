@@ -1,0 +1,111 @@
+"""Dtype-headroom advisor: which int32 state leaves provably fit
+int16/int8, from SimSpec bounds alone.
+
+ROADMAP item 4 wants narrow pool dtypes (int16 seqnos halve the memory
+traffic of the dense one-hot pool ops that dominate the programs), but
+narrowing on vibes is how overflow bugs ship. This advisor is the vetted
+input list: it walks every program's int32 state leaves and, for the
+leaves whose runtime range is a function of the STATIC SimSpec (step
+counters bounded by ``max_steps``, per-client sequence numbers bounded by
+``commands_per_client``, command counters bounded by
+``n_clients x commands_per_client``, source indices bounded by ``n``),
+reports the bound and the narrowest signed dtype that still holds DOUBLE
+it (2x headroom, so a +1-per-trip counter can never sit one increment
+from wrap at the claimed width).
+
+Advisories are deliberately NON-FAILING: they ride `lint --json`'s
+"advisories" list, never "violations" — the narrowing PR consumes them,
+and once a leaf actually narrows, the dtype rule's schema check takes
+over enforcement. The retraction direction is the load-bearing one and is
+pinned by tests: widen ``max_steps`` past int16's headroom and the
+``step`` leaf's int16 claim must disappear.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .rules import _leaf_name
+
+# 2x headroom: the claimed dtype must hold DOUBLE the static bound
+HEADROOM = 2
+
+# signed-dtype capacity ladder, narrowest first
+_LADDER = (("int8", 127), ("int16", 32767))
+
+
+def bounds_from_spec(spec) -> Dict[str, int]:
+    """Static upper bounds for the state-leaf names whose runtime range is
+    a function of the SimSpec. Only leaves listed here are claimable —
+    everything else (timestamps, latency sums, packed tie keys) has no
+    spec-derived bound and stays int32 until someone proves otherwise."""
+    if spec is None:
+        return {}
+    n = int(getattr(spec, "n", 0))
+    n_clients = int(getattr(spec, "n_clients", 0))
+    cpc = int(getattr(spec, "commands_per_client", 0))
+    max_steps = int(getattr(spec, "max_steps", 0))
+    total_cmds = n_clients * cpc
+    bounds = {
+        # loop progress counters: one increment per executed step
+        "step": max_steps,
+        "iters": max_steps,
+        # per-client sequence numbers: one per issued command
+        "next_seq": cpc,
+        "seqno": cpc,
+        # global command counters: every client's every command, counted
+        # at most once per process (the per-process total is the bound)
+        "c_issued": total_cmds,
+        "c_resp": total_cmds,
+        "lat_cnt": total_cmds,
+        "commit_count": total_cmds,
+        "fast_count": total_cmds,
+        "slow_count": total_cmds,
+        "executed_count": total_cmds,
+        # process indices
+        "i_src": n,
+    }
+    return {k: v for k, v in bounds.items() if v > 0}
+
+
+def _narrowest(bound: int) -> Optional[str]:
+    for dtype, cap in _LADDER:
+        if bound * HEADROOM <= cap:
+            return dtype
+    return None
+
+
+class HeadroomAdvisor:
+    """Non-failing advisor (`run_check(advisors=...)`): per program, the
+    int32 state leaves that provably fit a narrower dtype."""
+
+    id = "dtype-headroom"
+
+    def advise(self, program) -> List[Dict[str, Any]]:
+        bounds = bounds_from_spec(program.spec)
+        if not bounds:
+            return []
+        out: List[Dict[str, Any]] = []
+        for lf in program.state_in:
+            if lf.dtype != "int32":
+                continue
+            name = _leaf_name(lf.path)
+            bound = bounds.get(name)
+            if bound is None:
+                continue
+            suggested = _narrowest(bound)
+            if suggested is None:
+                continue
+            out.append({
+                "rule": "dtype-headroom/fits",
+                "program": program.name,
+                "path": lf.path,
+                "leaf": name,
+                "bound": bound,
+                "suggested": suggested,
+                "detail": f"int32 leaf bounded by {bound} (from SimSpec)"
+                          f" fits {suggested} with {HEADROOM}x headroom —"
+                          " a vetted narrowing candidate (ROADMAP item 4);"
+                          " the dtype schema rule enforces whatever width"
+                          " it actually becomes",
+            })
+        return out

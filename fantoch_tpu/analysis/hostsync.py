@@ -1,0 +1,445 @@
+"""AST lint for implicit device→host syncs on the serving/sweep hot paths.
+
+The serving loop's whole performance story is "one host sync per
+megachunk": the `account` span absorbs the single `jax.device_get`, every
+other host-side call stays asynchronous, and the runtime report asserts
+`syncs_per_megachunk == 1.0` after the fact. This module is the STATIC
+guardian of the same invariant — a pure-Python `ast` lint (no jax import,
+no tracing) over the declared hot scopes that flags every construct that
+would block the host on device work:
+
+- ``.item()`` on anything, ``jax.device_get(...)``, and
+  ``block_until_ready`` outside a ``with *.span(...)`` block — these ARE
+  syncs, always flagged;
+- ``np.asarray(x)`` / ``float(x)`` / ``int(x)`` / ``bool(x)`` where `x`
+  is PROVEN to be a device value — flagged only on proof, because the hot
+  paths are full of legitimate host coercions (`int(horizons[-1])`,
+  fleet bookkeeping) that must not drown the signal.
+
+"Proven device" is a deliberately shallow forward taint pass per scope:
+results of ``jnp.*`` calls, ``jax.device_put``, calls through names bound
+to ``jax.jit(...)`` anywhere in the module, and the per-path
+``device_calls`` hints (e.g. ``self.serve``) are device; ``np.*`` and
+``jax.device_get`` results are host; taint follows assignment (tuple
+unpacking included), attribute/subscript access, and arithmetic — and
+does NOT cross unknown calls. Shallow means false NEGATIVES are possible
+(a device value laundered through a helper), never false positives: a
+flag from this lint is real.
+
+Sanctioning is explicit and doubly bookkept: the offending line carries a
+``# sync-ok: <reason>`` pragma AND the scope has a sanction budget in its
+`HotPath` entry. A pragma'd sync past the budget fails
+(``host-sync/budget``), a pragma sanctioning nothing fails
+(``host-sync/stale-pragma`` — the sync it blessed moved), and a
+configured scope missing from its module fails
+(``host-sync/missing-scope`` — a rename silently un-linting a hot path is
+itself a regression). Driver: ``python -m fantoch_tpu lint --host-sync``
+(traces nothing) and tests/test_lint.py.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
+
+from .rules import Violation
+
+PRAGMA_RE = re.compile(r"#\s*sync-ok:\s*(.+)")
+
+# host coercion builtins that force a device value to materialize
+_COERCIONS = ("float", "int", "bool")
+
+
+@dataclasses.dataclass(frozen=True)
+class HotPath:
+    """One hot-path module: which scopes are hot, which calls produce
+    device values there, and how many sanctioned syncs each scope may
+    carry (scopes absent from `budgets` sanction zero)."""
+
+    module: str  # relpath under the fantoch_tpu package
+    scopes: Tuple[str, ...]  # qualified names: "Class.method", "outer.inner"
+    device_calls: Tuple[str, ...] = ()  # dotted call names returning device values
+    budgets: Mapping[str, int] = dataclasses.field(default_factory=dict)
+
+
+# The declared hot set: the serve loop (one sync per megachunk, absorbed
+# by the account span), the sweep drivers (one done-poll per chunk on the
+# non-donating path), the fleet scheduler (pure host — zero syncs), and
+# the quantum runner's host-side drivers.
+HOT_PATHS: Tuple[HotPath, ...] = (
+    HotPath(
+        module="ingress/runtime.py",
+        scopes=(
+            "ServeRuntime.run",
+            "ServeRuntime._plan",
+            "ServeRuntime._account",
+            "ServeRuntime._set_gauges",
+            "ServeRuntime._stalled",
+        ),
+        device_calls=("self.serve",),
+        budgets={"ServeRuntime._account": 1},
+    ),
+    HotPath(module="exp/serve.py", scopes=("run_serve",)),
+    HotPath(
+        module="engine/sweep.py",
+        scopes=("make_chunked_runner.done",),
+        budgets={"make_chunked_runner.done": 1},
+    ),
+    HotPath(
+        module="fleet/scheduler.py",
+        scopes=("run_fleet", "run_fleet.dispatch", "run_fleet.handle_reply"),
+    ),
+    HotPath(
+        module="parallel/quantum.py",
+        scopes=("build_runner.run_sharded", "build_runner.make_serve.serve"),
+    ),
+)
+
+
+def _dotted(node) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def _collect_jit_names(tree: ast.AST) -> Set[str]:
+    """Names bound to ``jax.jit(...)`` (or a cache ``*.wrap(...)`` of one)
+    ANYWHERE in the module — calling one from a hot scope yields device
+    values (e.g. engine/sweep.py's ``done_fn``)."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+            continue
+        fn = _dotted(node.value.func) or ""
+        if fn == "jax.jit" or fn.endswith(".wrap"):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    names.add(tgt.id)
+    return names
+
+
+def _index_scopes(tree: ast.AST) -> Dict[str, ast.AST]:
+    """Qualified-name index of every function in the module: methods as
+    ``Class.method``, nested defs as ``outer.inner`` (arbitrarily deep)."""
+    out: Dict[str, ast.AST] = {}
+
+    def visit(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                out[q] = child
+                visit(child, q + ".")
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.")
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return out
+
+
+def _exprs_no_nested_defs(node) -> List[ast.AST]:
+    """All descendant nodes of one STATEMENT, pruning nested function /
+    class bodies (they are their own scopes) and lambdas."""
+    out: List[ast.AST] = []
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)):
+            continue
+        out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+class _ScopeLint:
+    """One hot scope's sync scan + shallow taint pass."""
+
+    def __init__(self, *, relpath: str, scope: str, jit_names: Set[str],
+                 device_calls: Sequence[str], pragma_lines: Set[int]):
+        self.relpath = relpath
+        self.scope = scope
+        self.jit_names = jit_names
+        self.device_calls = set(device_calls)
+        self.pragma_lines = pragma_lines
+        self.tainted: Set[str] = set()
+        self.host: Set[str] = set()
+        # (lineno, primitive, detail) of every detected sync
+        self.syncs: List[Tuple[int, str, str]] = []
+
+    # -- taint ---------------------------------------------------------------
+
+    def _taint(self, node) -> Optional[str]:
+        """'device' | 'host' | None (unknown) for one expression."""
+        if isinstance(node, ast.Name):
+            if node.id in self.tainted:
+                return "device"
+            if node.id in self.host:
+                return "host"
+            return None
+        if isinstance(node, ast.Call):
+            fn = _dotted(node.func) or ""
+            if (fn.startswith("jnp.") or fn == "jax.device_put"
+                    or fn in self.device_calls or fn in self.jit_names):
+                return "device"
+            if fn.startswith("np.") or fn == "jax.device_get":
+                return "host"
+            return None  # unknown call: taint does NOT cross it
+        if isinstance(node, (ast.Attribute, ast.Subscript, ast.Starred)):
+            return self._taint(node.value)
+        if isinstance(node, ast.BinOp):
+            l, r = self._taint(node.left), self._taint(node.right)
+            return "device" if "device" in (l, r) else None
+        if isinstance(node, ast.UnaryOp):
+            return self._taint(node.operand)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            kinds = [self._taint(e) for e in node.elts]
+            return "device" if "device" in kinds else None
+        if isinstance(node, ast.IfExp):
+            kinds = (self._taint(node.body), self._taint(node.orelse))
+            return "device" if "device" in kinds else None
+        return None
+
+    def _assign_names(self, target, kind: Optional[str]) -> None:
+        if isinstance(target, ast.Name):
+            self.tainted.discard(target.id)
+            self.host.discard(target.id)
+            if kind == "device":
+                self.tainted.add(target.id)
+            elif kind == "host":
+                self.host.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for t in target.elts:
+                self._assign_names(t, kind)
+        elif isinstance(target, ast.Starred):
+            self._assign_names(target.value, kind)
+
+    def _assign(self, target, value) -> None:
+        """Assign with element-wise tuple unpacking: `a, b = f(q), host()`
+        must taint only `a` — whole-tuple tainting would drag every
+        unpacked host value into the device set."""
+        if (isinstance(target, (ast.Tuple, ast.List))
+                and isinstance(value, (ast.Tuple, ast.List))
+                and len(target.elts) == len(value.elts)
+                and not any(isinstance(t, ast.Starred)
+                            for t in target.elts)):
+            for t, v in zip(target.elts, value.elts):
+                self._assign(t, v)
+            return
+        self._assign_names(target, self._taint(value))
+
+    # -- sync detection ------------------------------------------------------
+
+    def _flag(self, node, primitive: str, detail: str) -> None:
+        self.syncs.append((node.lineno, primitive, detail))
+
+    def _scan_calls(self, stmt, span_depth: int) -> None:
+        for n in _exprs_no_nested_defs(stmt):
+            if not isinstance(n, ast.Call):
+                continue
+            fn = _dotted(n.func) or ""
+            if fn.endswith(".item") and not n.args:
+                self._flag(n, ".item()",
+                           "scalar .item() blocks the host on the device"
+                           " computation that produced the array")
+            elif fn == "jax.device_get":
+                self._flag(n, "jax.device_get",
+                           "explicit D2H transfer — a host sync")
+            elif fn.endswith("block_until_ready") and span_depth == 0:
+                self._flag(n, "block_until_ready",
+                           "block_until_ready outside a `with *.span(...)`"
+                           " block — an unaccounted host sync (spans are"
+                           " where the serve loop absorbs its one sync)")
+            elif fn == "np.asarray" and n.args \
+                    and self._taint(n.args[0]) == "device":
+                self._flag(n, "np.asarray",
+                           "np.asarray of a device value forces a D2H"
+                           " transfer")
+            elif fn in _COERCIONS and n.args \
+                    and self._taint(n.args[0]) == "device":
+                self._flag(n, f"{fn}()",
+                           f"{fn}() of a device value blocks on the device"
+                           " computation")
+
+    # -- statement walk ------------------------------------------------------
+
+    def _is_span_with(self, stmt: ast.With) -> bool:
+        for item in stmt.items:
+            ce = item.context_expr
+            if isinstance(ce, ast.Call):
+                fn = _dotted(ce.func) or ""
+                if fn.endswith(".span"):
+                    return True
+        return False
+
+    def run(self, fn_node) -> None:
+        self._block(fn_node.body, span_depth=0)
+
+    def _block(self, stmts, span_depth: int) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested scopes lint separately (if configured)
+            if isinstance(stmt, ast.With):
+                depth = span_depth + (1 if self._is_span_with(stmt) else 0)
+                # the context expressions themselves run un-spanned
+                for item in stmt.items:
+                    self._scan_calls(item.context_expr, span_depth)
+                self._block(stmt.body, depth)
+                continue
+            self._scan_calls(stmt, span_depth)
+            if isinstance(stmt, ast.Assign):
+                for tgt in stmt.targets:
+                    self._assign(tgt, stmt.value)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                self._assign_names(stmt.target, self._taint(stmt.value))
+            elif isinstance(stmt, ast.AugAssign):
+                if self._taint(stmt.value) == "device":
+                    self._assign_names(stmt.target, "device")
+            elif isinstance(stmt, ast.For):
+                self._assign_names(stmt.target, self._taint(stmt.iter))
+                self._block(stmt.body, span_depth)
+                self._block(stmt.orelse, span_depth)
+            elif isinstance(stmt, (ast.If, ast.While)):
+                self._block(stmt.body, span_depth)
+                self._block(stmt.orelse, span_depth)
+            elif isinstance(stmt, ast.Try):
+                self._block(stmt.body, span_depth)
+                for h in stmt.handlers:
+                    self._block(h.body, span_depth)
+                self._block(stmt.orelse, span_depth)
+                self._block(stmt.finalbody, span_depth)
+
+
+def lint_source(
+    src: str,
+    relpath: str,
+    hot: HotPath,
+) -> Tuple[List[Violation], int, int]:
+    """Lint ONE module's source against its `HotPath` config.
+
+    Returns ``(violations, scopes_checked, sanctioned_syncs)``. Pure
+    function of the source text — the unit tests inject `.item()` calls /
+    strip pragmas and assert on the verdict."""
+    violations: List[Violation] = []
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return ([Violation(
+            rule="host-sync/unparsable", program=relpath,
+            path=f"{relpath}:{e.lineno or 0}", primitive="",
+            detail=f"cannot parse module: {e.msg}",
+        )], 0, 0)
+    pragma_lines = {
+        i + 1 for i, line in enumerate(src.splitlines())
+        if PRAGMA_RE.search(line)
+    }
+    jit_names = _collect_jit_names(tree)
+    index = _index_scopes(tree)
+    scopes_checked = 0
+    sanctioned_total = 0
+    for scope in hot.scopes:
+        node = index.get(scope)
+        if node is None:
+            violations.append(Violation(
+                rule="host-sync/missing-scope",
+                program=f"{relpath}:{scope}", path=relpath, primitive="",
+                detail=f"configured hot scope {scope!r} not found in the"
+                       " module — a rename silently un-lints the hot path;"
+                       " update analysis/hostsync.py HOT_PATHS",
+            ))
+            continue
+        scopes_checked += 1
+        lint = _ScopeLint(
+            relpath=relpath, scope=scope, jit_names=jit_names,
+            device_calls=hot.device_calls, pragma_lines=pragma_lines,
+        )
+        lint.run(node)
+        budget = int(hot.budgets.get(scope, 0))
+        sanctioned_here = 0
+        consumed: Set[int] = set()
+        for lineno, primitive, detail in lint.syncs:
+            # a pragma sanctions the sync on its own line or the line
+            # directly below it (a standalone comment above the statement)
+            pl = lineno if lineno in pragma_lines else (
+                lineno - 1 if lineno - 1 in pragma_lines else None
+            )
+            if pl is not None:
+                sanctioned_here += 1
+                consumed.add(pl)
+                continue
+            violations.append(Violation(
+                rule="host-sync/sync", program=f"{relpath}:{scope}",
+                path=f"{relpath}:{lineno}", primitive=primitive,
+                detail=detail + " (sanction deliberately with a"
+                       " `# sync-ok: <reason>` pragma AND a HotPath"
+                       " budget)",
+            ))
+        if sanctioned_here > budget:
+            violations.append(Violation(
+                rule="host-sync/budget", program=f"{relpath}:{scope}",
+                path=relpath, primitive="",
+                detail=f"{sanctioned_here} pragma-sanctioned sync(s) but"
+                       f" the scope's budget is {budget} — the"
+                       " one-sync-per-megachunk contract admits exactly"
+                       " the budgeted set; raise the HotPath budget only"
+                       " with a reason",
+            ))
+        sanctioned_total += sanctioned_here
+        # pragmas inside this scope that sanctioned nothing: the sync
+        # they blessed moved or died — the pragma must move with it
+        lo = node.lineno
+        hi = getattr(node, "end_lineno", node.lineno)
+        for ln in sorted(pragma_lines):
+            if lo <= ln <= hi and ln not in consumed:
+                violations.append(Violation(
+                    rule="host-sync/stale-pragma",
+                    program=f"{relpath}:{scope}",
+                    path=f"{relpath}:{ln}", primitive="",
+                    detail="`# sync-ok:` pragma on a line with no"
+                           " detected sync — remove it or move it to the"
+                           " actual sync line",
+                ))
+    return violations, scopes_checked, sanctioned_total
+
+
+def lint_paths(
+    root: Optional[str] = None,
+    hot_paths: Sequence[HotPath] = HOT_PATHS,
+) -> Dict[str, object]:
+    """Lint every configured hot-path module under `root` (default: the
+    installed fantoch_tpu package). Returns ``{"violations": [Violation],
+    "files": int, "scopes": int, "sanctioned": int}``."""
+    root = root or os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    violations: List[Violation] = []
+    files = scopes = sanctioned = 0
+    for hot in hot_paths:
+        path = os.path.join(root, *hot.module.split("/"))
+        try:
+            with open(path) as f:
+                src = f.read()
+        except OSError as e:
+            violations.append(Violation(
+                rule="host-sync/missing-module", program=hot.module,
+                path=hot.module, primitive="",
+                detail=f"configured hot-path module missing: {e}",
+            ))
+            continue
+        files += 1
+        vs, sc, sa = lint_source(src, hot.module, hot)
+        violations.extend(vs)
+        scopes += sc
+        sanctioned += sa
+    return {
+        "violations": violations,
+        "files": files,
+        "scopes": scopes,
+        "sanctioned": sanctioned,
+    }

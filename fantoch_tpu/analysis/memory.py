@@ -1,0 +1,389 @@
+"""Static peak-memory estimation over traced engine jaxprs.
+
+The resource half of the engine contract (analysis/rules.py covers the
+shape half): ROADMAP item 1 (a server that runs forever in fixed memory)
+and item 2 (the first real v5e-8 run, 16 GiB HBM per chip, where an OOM
+burns the hardware budget) both hinge on numbers nothing computed
+statically before this module — how many bytes a driver program keeps
+resident across calls and how high its transient working set peaks inside
+one call. Both are decidable from the closed jaxpr alone, the same way the
+dtype rule decides widening: no compilation, no execution, every protocol,
+in CI.
+
+The model is a donation-aware live-range scan:
+
+- **resident** — the bytes of every program input and closure constant
+  (the state the host must keep on device between calls; for the donating
+  drivers this is THE serving working set, since outputs alias into it);
+- **peak** — a linear scan over the equations tracking live buffer bytes:
+  an equation's outputs materialize before its operands die, operands are
+  freed at their last use (donated inputs and temporaries only —
+  non-donated inputs and constants stay live for the whole call, which is
+  XLA's buffer contract), `while`/`scan` carries alias their dying inputs
+  in place (the in-place loop-carry update donation exists to enable), and
+  sub-jaxprs (`while`/`cond`/`scan`/`pjit`/`shard_map`) contribute their
+  own recursive peak beyond the operand/result bytes the outer scan
+  already accounts for.
+
+The estimate is deliberately simple — it knows nothing of XLA fusion or
+rematerialization — so it is NOT trusted blind: tools/trip_profile.py
+cross-checks it against the backend's measured buffer assignment
+(`compiled.memory_analysis()`) on the megachunk drivers and hard-fails
+past `CROSSCHECK_TOLERANCE`. Within that documented factor it is a sound
+regression tripwire, which is all the budget manifest asks of it.
+
+Budgets live in analysis/memory_budgets.json with the exact semantics of
+hlo_budgets.json: every engine program needs a committed
+``{"resident": bytes, "peak": bytes}`` entry, >10% growth over either
+number fails lint, a missing entry fails lint, and
+``lint --update-budgets`` is the sanctioned re-baseline (it rewrites BOTH
+manifests atomically with merge semantics — see
+`update_budget_manifests`).
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import tempfile
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# NOTE: no top-level import from .rules — rules.py imports MemoryRule at
+# its bottom (to append it to ALL_RULES), so this module must stay
+# importable first; everything from rules is imported lazily inside the
+# functions that need it.
+
+# allowed growth over a committed budget before the rule fires — matches
+# HLO_BUDGET_SLACK: organic drift (a new trace channel) stays under it, a
+# doubled pool or an unrolled loop fails lint
+MEMORY_BUDGET_SLACK = 0.10
+
+# trip_profile's measured-vs-static gate: the static peak must be within
+# this FACTOR of the backend's measured (argument + output + temp) bytes
+# in either direction. The estimator ignores fusion (which shrinks the
+# real temp set) and XLA's buffer padding (which grows it), so a tight
+# bound is not honest — but an estimator drifting past 8x of measured
+# reality has stopped describing the program and must fail the profile.
+CROSSCHECK_TOLERANCE = 8.0
+
+_BUDGET_PATH = os.path.join(os.path.dirname(__file__), "memory_budgets.json")
+
+# loop-carry primitives whose outputs alias their dying inputs in place
+# (XLA's donated while-carry / scan-carry update): counting carry-out as a
+# fresh buffer would double every loop-resident state
+_CARRY_PRIMS = frozenset({"while", "scan"})
+
+
+def bytes_of_aval(aval) -> int:
+    """Device bytes of one abstract value (0 for tokens/opaque avals)."""
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    try:
+        itemsize = np.dtype(str(dtype)).itemsize
+    except TypeError:
+        return 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * itemsize
+
+
+def _is_literal(v) -> bool:
+    return hasattr(v, "val")
+
+
+def estimate_jaxpr_bytes(
+    jaxpr, donated: Sequence[bool] = ()
+) -> Dict[str, int]:
+    """``{"resident": bytes, "peak": bytes}`` of one (sub-)jaxpr.
+
+    `donated` aligns with `jaxpr.invars`; missing entries default False.
+    Non-donated inputs and constants are frozen (live for the whole call);
+    everything else frees at its last read. Sub-jaxprs are estimated
+    recursively with all inputs freeable (a loop body's carry updates in
+    place; a pjit's operands alias the outer buffers), and contribute the
+    part of their peak that exceeds the operand/result bytes the outer
+    scan already counts."""
+    from .rules import _sub_jaxprs
+
+    def b(v) -> int:
+        return bytes_of_aval(getattr(v, "aval", None))
+
+    don = list(donated) + [False] * (len(jaxpr.invars) - len(donated))
+    # last read per var; vars feeding the jaxpr outputs live to the end
+    last: Dict[Any, int] = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if not _is_literal(v):
+                last[v] = i
+    for v in jaxpr.outvars:
+        if not _is_literal(v):
+            last[v] = len(jaxpr.eqns)
+
+    live = 0
+    alive = set()
+    frozen = set()
+    for v, dflag in zip(jaxpr.invars, don):
+        alive.add(v)
+        live += b(v)
+        if not dflag:
+            frozen.add(v)
+    for v in jaxpr.constvars:
+        alive.add(v)
+        live += b(v)
+        frozen.add(v)
+    resident = live
+    peak = live
+
+    for i, eqn in enumerate(jaxpr.eqns):
+        # recursive transient: the inner program's peak beyond the
+        # operand/result buffers this scan already tracks
+        inner_extra = 0
+        boundary = sum(b(v) for v in eqn.invars) \
+            + sum(b(v) for v in eqn.outvars)
+        for _tag, sub in _sub_jaxprs(eqn):
+            sub_peak = estimate_jaxpr_bytes(
+                sub, donated=[True] * len(sub.invars)
+            )["peak"]
+            inner_extra = max(inner_extra, max(0, sub_peak - boundary))
+
+        dying = [
+            v for v in dict.fromkeys(
+                v for v in eqn.invars if not _is_literal(v)
+            )
+            if v in alive and last.get(v) == i and v not in frozen
+        ]
+        out_add: Dict[Any, int] = {}
+        transferred = set()
+        if eqn.primitive.name in _CARRY_PRIMS:
+            # carry aliasing: an output matching a dying input's
+            # shape/dtype reuses its buffer in place (multiset matching,
+            # like the donation rule's alias-eligibility)
+            pool: Dict[Tuple, List[Any]] = {}
+            for v in dying:
+                key = (tuple(v.aval.shape), str(v.aval.dtype))
+                pool.setdefault(key, []).append(v)
+            for o in eqn.outvars:
+                aval = getattr(o, "aval", None)
+                key = (tuple(getattr(aval, "shape", ())),
+                       str(getattr(aval, "dtype", "?")))
+                bucket = pool.get(key)
+                if bucket:
+                    transferred.add(bucket.pop())
+                    out_add[o] = 0
+                else:
+                    out_add[o] = b(o)
+        else:
+            for o in eqn.outvars:
+                out_add[o] = b(o)
+
+        add = sum(out_add.values())
+        peak = max(peak, live + add + inner_extra)
+        live += add
+        for v in dying:
+            if v not in transferred:
+                alive.discard(v)
+                live -= b(v)
+        for o in eqn.outvars:
+            if o in last:
+                alive.add(o)
+            else:
+                # an output never read again (dead value) frees at once —
+                # only what this eqn actually added (aliased carries add 0)
+                live -= out_add[o]
+    return {"resident": int(resident), "peak": int(peak)}
+
+
+def estimate_traced(traced) -> Dict[str, int]:
+    """Estimate a ``jax.jit(...).trace(...)`` result directly (donation
+    flags read off `args_info`) — tools/trip_profile.py's entry point."""
+    import jax
+
+    donated = [
+        bool(getattr(ai, "donated", False))
+        for ai in jax.tree_util.tree_leaves(traced.args_info)
+    ]
+    return estimate_jaxpr_bytes(traced.jaxpr.jaxpr, donated)
+
+
+def estimate_program(program) -> Dict[str, int]:
+    """Estimate (and cache on) one checker `Program`."""
+    if getattr(program, "memory", None) is None:
+        donated = [lf.donated for lf in program.args]
+        program.memory = estimate_jaxpr_bytes(
+            program.jaxpr.jaxpr, donated
+        )
+    return program.memory
+
+
+# ---------------------------------------------------------------------------
+# budget manifest (analysis/memory_budgets.json)
+# ---------------------------------------------------------------------------
+
+
+def load_memory_manifest(
+    path: Optional[str] = None,
+) -> Tuple[Dict[str, Dict[str, int]], float]:
+    """(name -> {"resident", "peak"} budgets, slack). Like the HLO
+    manifest, the persisted slack is honored, not decorative."""
+    try:
+        with open(path or _BUDGET_PATH) as f:
+            data = json.load(f)
+        budgets = {
+            str(k): {"resident": int(v["resident"]), "peak": int(v["peak"])}
+            for k, v in data.get("budgets", {}).items()
+        }
+        return budgets, float(data.get("slack", MEMORY_BUDGET_SLACK))
+    except (OSError, ValueError, TypeError, KeyError):
+        return {}, MEMORY_BUDGET_SLACK
+
+
+def load_memory_budgets(
+    path: Optional[str] = None,
+) -> Dict[str, Dict[str, int]]:
+    return load_memory_manifest(path)[0]
+
+
+def _atomic_write_json(doc: dict, path: str) -> None:
+    """Write-to-temp + rename in the manifest's directory: a crash
+    mid-serialization can never leave a half-written manifest."""
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def save_memory_budgets(budgets: Dict[str, Dict[str, int]],
+                        path: Optional[str] = None) -> str:
+    path = path or _BUDGET_PATH
+    _atomic_write_json(
+        {"slack": MEMORY_BUDGET_SLACK,
+         "budgets": {k: budgets[k] for k in sorted(budgets)}},
+        path,
+    )
+    return path
+
+
+def update_budget_manifests(
+    program_records: Sequence[Dict[str, Any]],
+    hlo_path: Optional[str] = None,
+    memory_path: Optional[str] = None,
+) -> Tuple[str, str]:
+    """The `lint --update-budgets` re-baseline for BOTH manifests.
+
+    Merge semantics: this run's eqn counts / memory estimates overwrite
+    their programs' entries, every untraced program's committed budget
+    survives — so a partial-matrix run (one protocol, one engine, a
+    too-small device mesh skipping quantum) can never silently drop the
+    rest of the fleet's budgets. Each manifest is written atomically
+    (temp + rename), and both are serialized before either is renamed, so
+    a failure mid-update leaves both files valid (at worst one of the two
+    re-baselined)."""
+    from . import rules as rules_mod
+
+    hlo = dict(rules_mod.load_hlo_budgets(hlo_path))
+    mem = dict(load_memory_budgets(memory_path))
+    for rec in program_records:
+        name = rec["name"]
+        if rec.get("eqns") is not None:
+            hlo[name] = int(rec["eqns"])
+        m = rec.get("memory")
+        if m:
+            mem[name] = {"resident": int(m["resident"]),
+                         "peak": int(m["peak"])}
+    hlo_doc = {
+        "slack": rules_mod.HLO_BUDGET_SLACK,
+        "budgets": {k: hlo[k] for k in sorted(hlo)},
+    }
+    mem_doc = {
+        "slack": MEMORY_BUDGET_SLACK,
+        "budgets": {k: mem[k] for k in sorted(mem)},
+    }
+    hp = hlo_path or rules_mod._BUDGET_PATH
+    mp = memory_path or _BUDGET_PATH
+    _atomic_write_json(hlo_doc, hp)
+    _atomic_write_json(mem_doc, mp)
+    return hp, mp
+
+
+# ---------------------------------------------------------------------------
+# rule
+# ---------------------------------------------------------------------------
+
+
+class MemoryRule:
+    """Every ENGINE program's estimated resident and peak bytes stay
+    within slack of their committed budgets (analysis/memory_budgets.json)
+    — the resource twin of the hlo-size rule. Synthetic programs (engine
+    "?") are exempt; `lint --update-budgets` is the escape hatch."""
+
+    id = "memory"
+
+    def __init__(self,
+                 budgets: Optional[Dict[str, Dict[str, int]]] = None,
+                 slack: Optional[float] = None):
+        self._budgets = budgets
+        self._slack = slack
+
+    @property
+    def budgets(self) -> Dict[str, Dict[str, int]]:
+        if self._budgets is None:
+            self._budgets, file_slack = load_memory_manifest()
+            if self._slack is None:
+                self._slack = file_slack
+        return self._budgets
+
+    @property
+    def slack(self) -> float:
+        if self._slack is None:
+            self.budgets
+        return self._slack if self._slack is not None \
+            else MEMORY_BUDGET_SLACK
+
+    def check(self, program) -> List["Violation"]:
+        from .rules import Violation
+
+        if program.engine == "?":
+            return []
+        est = estimate_program(program)
+        budget = self.budgets.get(program.name)
+        if budget is None:
+            return [Violation(
+                rule="memory/unbudgeted", program=program.name,
+                path="memory_budgets.json", primitive="",
+                detail=f"no memory budget recorded for this program"
+                       f" (currently resident={est['resident']}"
+                       f" peak={est['peak']} bytes) — run"
+                       " `python -m fantoch_tpu lint --update-budgets`",
+            )]
+        out: List[Violation] = []
+        for kind in ("resident", "peak"):
+            limit = int(math.ceil(budget[kind] * (1.0 + self.slack)))
+            if est[kind] > limit:
+                pct = 100.0 * (est[kind] - budget[kind]) \
+                    / max(budget[kind], 1)
+                out.append(Violation(
+                    rule="memory/regression", program=program.name,
+                    path=kind, primitive="",
+                    detail=f"estimated {kind} {est[kind]} bytes is"
+                           f" +{pct:.0f}% over the {budget[kind]}-byte"
+                           f" budget (> {self.slack:.0%} slack) — a"
+                           " device-memory regression (v5e-8 sizing and"
+                           " the fixed-memory serving contract depend on"
+                           " these staying flat); if intentional,"
+                           " re-baseline with `lint --update-budgets`",
+                ))
+        return out

@@ -238,15 +238,42 @@ COUNTER_HEADROOM = 8
 
 
 class PurityRule:
-    """No host callbacks or host transfers anywhere in a jitted region."""
+    """No host callbacks or host transfers anywhere in a jitted region.
+
+    One carve-out (the ROADMAP'd sanctioned-ordered-effect distinction):
+    an ORDERED ``io_callback`` is a deliberate effect channel — ordering
+    pins it to the program's sequencing, so it is a declared side channel,
+    not an accidental sync. A program may sanction it by listing the
+    primitive in ``Program.sanctioned_effects``; sanctioned ordered
+    effects pass, unsanctioned ones fail under their own rule id
+    (``purity/ordered-effect``) so the report distinguishes "you forgot to
+    declare your effect channel" from "a stray callback leaked into the
+    hot path". Unordered callbacks are never sanctionable — without
+    ordering they can be elided/reordered by the compiler and exist only
+    as debugging leaks."""
 
     id = "purity"
 
     def check(self, program) -> List[Violation]:
+        sanctioned = frozenset(getattr(program, "sanctioned_effects", ()))
         out: List[Violation] = []
         for path, eqn in walk(program.jaxpr.jaxpr):
             name = eqn.primitive.name
             if name in CALLBACK_PRIMS:
+                ordered = bool(eqn.params.get("ordered", False))
+                if name == "io_callback" and ordered:
+                    if name in sanctioned:
+                        continue  # declared ordered-effect channel
+                    out.append(Violation(
+                        rule="purity/ordered-effect", program=program.name,
+                        path=path, primitive=name,
+                        detail="ordered io_callback is an effect channel"
+                               " this program never declared — sanction it"
+                               " via sanctioned_effects=('io_callback',) if"
+                               " the host round-trip per execution is"
+                               " intentional",
+                    ))
+                    continue
                 out.append(Violation(
                     rule="purity/callback", program=program.name, path=path,
                     primitive=name,
@@ -644,5 +671,10 @@ def check_trace_stability(program, retraced_signature: str) -> List[Violation]:
     )]
 
 
+# imported at the bottom on purpose: memory.py needs Violation/_sub_jaxprs
+# from this module (it imports them lazily, inside functions, so either
+# module can be imported first)
+from .memory import MemoryRule  # noqa: E402
+
 ALL_RULES = (PurityRule(), DtypeRule(), DonationRule(), StaticKeyRule(),
-             HloSizeRule())
+             HloSizeRule(), MemoryRule())

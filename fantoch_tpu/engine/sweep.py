@@ -129,6 +129,7 @@ def make_chunked_runner(
     done_fn = jax.jit(jax.vmap(eng.done_flag))
 
     def done(st: SimState) -> bool:
+        # sync-ok: the chunked runner's done poll — one sync per chunk by design
         return bool(np.asarray(done_fn(st)).all())
 
     return init, chunk, done

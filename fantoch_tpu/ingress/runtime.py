@@ -419,7 +419,7 @@ class ServeRuntime:
     # -- accounting ---------------------------------------------------------
 
     def _account(self, pulse, snap: Dict[int, int]) -> None:
-        p = jax.device_get(pulse)  # the ONE host sync of this megachunk
+        p = jax.device_get(pulse)  # sync-ok: the ONE host sync of this megachunk
         self.host_syncs += 1
         if int(np.asarray(p.inj_drop).sum()):
             raise ServeHealthError(

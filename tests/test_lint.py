@@ -18,7 +18,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from fantoch_tpu.analysis import checker, rules
+from fantoch_tpu.analysis import checker, headroom, hostsync, rules
+from fantoch_tpu.analysis import memory as mem
 
 
 # ---------------------------------------------------------------------------
@@ -53,6 +54,13 @@ def test_lint_clean_fast_subset():
     for kind, recs in by_kind.items():
         for rec in recs:
             assert rec["schema_leaves"] >= 50, (kind, rec["schema_leaves"])
+    # the memory estimate rode along for every program (the fleet report
+    # bin-packs on these), and the committed budgets covered them (the
+    # report was clean above, so no memory/unbudgeted fired)
+    for kind, recs in by_kind.items():
+        for rec in recs:
+            assert rec["memory"]["resident"] > 0, kind
+            assert rec["memory"]["peak"] >= rec["memory"]["resident"], kind
 
 
 @pytest.mark.slow
@@ -392,6 +400,341 @@ def test_hlo_size_manifest_covers_fast_subset():
 
 
 # ---------------------------------------------------------------------------
+# purity: sanctioned ordered-effect channel vs violation
+# ---------------------------------------------------------------------------
+
+
+def _io_callback_program(ordered, sanctioned):
+    from jax.experimental import io_callback
+
+    def f(x):
+        io_callback(lambda v: None, None, x, ordered=ordered)
+        return x + 1
+
+    return checker.program_from_traced(
+        jax.jit(f).trace(jnp.int32(0)), name="toy.effect", kind="toy",
+        sanctioned_effects=("io_callback",) if sanctioned else (),
+    )
+
+
+def test_purity_ordered_effect_requires_sanction():
+    """An ORDERED io_callback is a declared effect channel only when the
+    program sanctions it: unsanctioned it fails under its own rule id
+    (distinct from a stray callback), sanctioned it passes."""
+    vs = rules.PurityRule().check(_io_callback_program(True, False))
+    assert [v.rule for v in vs] == ["purity/ordered-effect"]
+    assert "sanctioned_effects" in vs[0].detail
+
+    assert rules.PurityRule().check(_io_callback_program(True, True)) == []
+
+
+def test_purity_unordered_callback_never_sanctionable():
+    """Sanctioning covers ONLY the ordered channel: an unordered
+    io_callback (the compiler may elide/reorder it — a debugging leak, not
+    an effect channel) fails as a plain purity/callback even when the
+    program sanctions io_callback."""
+    vs = rules.PurityRule().check(_io_callback_program(False, True))
+    assert [v.rule for v in vs] == ["purity/callback"]
+
+
+# ---------------------------------------------------------------------------
+# memory: live-range estimates + budget manifest
+# ---------------------------------------------------------------------------
+
+
+def test_memory_estimate_donation_and_loop_carry():
+    """The estimator's two load-bearing behaviors: a donated input frees
+    (peak below the frozen non-donated case), and a while-loop carry
+    aliases in place (the loop does not double the carried buffer)."""
+    def f(x):
+        y = x * 2.0
+        return y + 1.0
+
+    x = jnp.zeros((256, 256), jnp.float32)  # 262144 bytes
+    t_don = jax.jit(f, donate_argnums=(0,)).trace(x)
+    t_keep = jax.jit(f).trace(x)
+    don = mem.estimate_traced(t_don)
+    keep = mem.estimate_traced(t_keep)
+    assert don["resident"] == keep["resident"] == 262144
+    assert don["peak"] < keep["peak"]
+
+    def loop(x):
+        def body(c):
+            i, v = c
+            return i + 1, v * 2.0
+        return jax.lax.while_loop(lambda c: c[0] < 10, body, (0, x))
+
+    est = mem.estimate_traced(jax.jit(loop, donate_argnums=(0,)).trace(x))
+    # donated input + in-place carry: the [256,256] buffer is counted
+    # once, not once per loop boundary
+    assert est["peak"] < 2 * 262144, est
+
+
+def test_memory_flags_regression_over_budget():
+    prog = _engine_toy("toy.mem")
+    est = mem.estimate_program(prog)
+    tight = mem.MemoryRule(
+        budgets={prog.name: {"resident": est["resident"],
+                             "peak": est["peak"] - 1}},
+        slack=0.0,
+    )
+    vs = tight.check(prog)
+    assert [v.rule for v in vs] == ["memory/regression"]
+    assert vs[0].path == "peak"
+    assert "re-baseline" in vs[0].detail
+    ok = mem.MemoryRule(budgets={prog.name: est})
+    assert ok.check(prog) == []
+    # slack is honored on both axes: 10% under passes, more fails
+    prog2 = _engine_toy("toy.mem2")
+    est2 = dict(mem.estimate_program(prog2))
+    under = mem.MemoryRule(
+        budgets={prog2.name: {"resident": int(est2["resident"] / 1.05),
+                              "peak": est2["peak"]}},
+        slack=0.10,
+    )
+    assert under.check(prog2) == []
+    over = mem.MemoryRule(
+        budgets={prog2.name: {"resident": int(est2["resident"] / 1.25),
+                              "peak": est2["peak"]}},
+        slack=0.10,
+    )
+    assert [v.rule for v in over.check(prog2)] == ["memory/regression"]
+    assert over.check(prog2)[0].path == "resident"
+
+
+def test_memory_flags_unbudgeted_engine_program():
+    prog = _engine_toy("toy.mem-unbudgeted")
+    vs = mem.MemoryRule(budgets={}).check(prog)
+    assert [v.rule for v in vs] == ["memory/unbudgeted"]
+    assert "--update-budgets" in vs[0].detail
+
+    toy = checker.program_from_traced(
+        jax.jit(lambda x: x + 1).trace(jnp.int32(0)),
+        name="toy.mem-exempt", kind="toy",
+    )
+    assert mem.MemoryRule(budgets={}).check(toy) == []
+
+
+def test_memory_manifest_covers_fast_subset():
+    """analysis/memory_budgets.json budgets the tier-1 fast subset — the
+    memory rule is live, not vacuously skipping on missing entries."""
+    budgets = mem.load_memory_budgets()
+    assert budgets, "memory_budgets.json missing or empty"
+    programs = checker.lockstep_programs("basic", trace=False, faults=None)
+    for p in programs:
+        assert p.name in budgets, p.name
+        assert set(budgets[p.name]) == {"resident", "peak"}
+
+
+def test_update_budget_manifests_merges_partial_runs(tmp_path):
+    """`lint --update-budgets` merge semantics: a partial-matrix run
+    re-baselines only the programs it traced — every other committed
+    budget survives, in BOTH manifests."""
+    import json
+
+    hlo_path = str(tmp_path / "hlo.json")
+    mem_path = str(tmp_path / "mem.json")
+    rules.save_hlo_budgets({"kept.prog": 100, "retraced.prog": 50},
+                           path=hlo_path)
+    mem.save_memory_budgets(
+        {"kept.prog": {"resident": 10, "peak": 20},
+         "retraced.prog": {"resident": 1, "peak": 2}},
+        path=mem_path,
+    )
+    records = [{"name": "retraced.prog", "eqns": 60,
+                "memory": {"resident": 3, "peak": 4}},
+               {"name": "new.prog", "eqns": 7,
+                "memory": {"resident": 5, "peak": 6}}]
+    mem.update_budget_manifests(records, hlo_path=hlo_path,
+                                memory_path=mem_path)
+    with open(hlo_path) as f:
+        hlo = json.load(f)["budgets"]
+    with open(mem_path) as f:
+        memb = json.load(f)["budgets"]
+    assert hlo == {"kept.prog": 100, "retraced.prog": 60, "new.prog": 7}
+    assert memb["kept.prog"] == {"resident": 10, "peak": 20}
+    assert memb["retraced.prog"] == {"resident": 3, "peak": 4}
+    assert memb["new.prog"] == {"resident": 5, "peak": 6}
+
+
+# ---------------------------------------------------------------------------
+# host-sync AST lint
+# ---------------------------------------------------------------------------
+
+
+def test_hostsync_real_hot_paths_clean():
+    """The shipped serving/sweep/fleet hot paths lint clean, with exactly
+    the two sanctioned syncs (serve account's device_get, the chunked
+    runner's done poll) carrying pragmas."""
+    res = hostsync.lint_paths()
+    assert res["violations"] == [], [str(v) for v in res["violations"]]
+    assert res["files"] == len(hostsync.HOT_PATHS)
+    assert res["scopes"] == sum(len(h.scopes) for h in hostsync.HOT_PATHS)
+    assert res["sanctioned"] == 2
+
+
+_HOT = hostsync.HotPath(module="toy.py", scopes=("hot",))
+
+
+def test_hostsync_flags_injected_item():
+    src = (
+        "import jax\n"
+        "def hot(x):\n"
+        "    return x.item()\n"
+    )
+    vs, scopes, sanc = hostsync.lint_source(src, "toy.py", _HOT)
+    assert scopes == 1 and sanc == 0
+    assert [v.rule for v in vs] == ["host-sync/sync"]
+    assert vs[0].primitive == ".item()"
+    assert vs[0].path == "toy.py:3"
+
+
+def test_hostsync_flags_unsanctioned_device_get_and_budget():
+    base = (
+        "import jax\n"
+        "def hot(x):\n"
+        "    {pragma}\n"
+        "    return jax.device_get(x)\n"
+    )
+    # no pragma: a plain violation
+    vs, _, _ = hostsync.lint_source(
+        base.format(pragma="pass"), "toy.py", _HOT
+    )
+    assert [v.rule for v in vs] == ["host-sync/sync"]
+    assert vs[0].primitive == "jax.device_get"
+    # pragma'd but the scope's budget is 0: the sanction itself fails
+    src = base.format(pragma="# sync-ok: testing")
+    vs, _, sanc = hostsync.lint_source(src, "toy.py", _HOT)
+    assert sanc == 1
+    assert [v.rule for v in vs] == ["host-sync/budget"]
+    # pragma + budget: clean
+    budgeted = hostsync.HotPath(module="toy.py", scopes=("hot",),
+                                budgets={"hot": 1})
+    vs, _, sanc = hostsync.lint_source(src, "toy.py", budgeted)
+    assert vs == [] and sanc == 1
+
+
+def test_hostsync_taint_gates_coercions():
+    """float()/int()/np.asarray flag ONLY proven device values: jnp
+    results and jit-bound-call results are device (through tuple unpack
+    and attribute access), unknown-call results are not — the design that
+    keeps the fleet scheduler's host coercions out of the report."""
+    src = (
+        "import jax, jax.numpy as jnp, numpy as np\n"
+        "f = jax.jit(lambda x: x)\n"
+        "def hot(q):\n"
+        "    a, b = f(q), q.helper()\n"
+        "    bad1 = float(a)\n"          # jit-bound call -> device
+        "    ok1 = float(b)\n"           # unknown call -> unflagged
+        "    c = jnp.zeros(3)\n"
+        "    bad2 = int(c[0])\n"         # subscript of device
+        "    d = np.asarray(c)\n"        # device -> flagged sync
+        "    ok2 = int(d[0])\n"          # np result is host
+        "    ok3 = bool(len(q))\n"
+        "    return bad1, bad2\n"
+    )
+    vs, _, _ = hostsync.lint_source(src, "toy.py", _HOT)
+    flagged = sorted((v.path, v.primitive) for v in vs)
+    assert flagged == [
+        ("toy.py:5", "float()"),
+        ("toy.py:8", "int()"),
+        ("toy.py:9", "np.asarray"),
+    ], flagged
+
+
+def test_hostsync_block_until_ready_span_absorption():
+    src = (
+        "import jax\n"
+        "def hot(x, reg):\n"
+        "    with reg.span('account'):\n"
+        "        jax.block_until_ready(x)\n"
+        "    jax.block_until_ready(x)\n"
+    )
+    vs, _, _ = hostsync.lint_source(src, "toy.py", _HOT)
+    assert [(v.path, v.primitive) for v in vs] \
+        == [("toy.py:5", "block_until_ready")]
+
+
+def test_hostsync_missing_scope_and_stale_pragma():
+    # a configured scope that vanished (renamed) must fail, not un-lint
+    vs, scopes, _ = hostsync.lint_source(
+        "def other():\n    pass\n", "toy.py", _HOT
+    )
+    assert scopes == 0
+    assert [v.rule for v in vs] == ["host-sync/missing-scope"]
+    # a pragma sanctioning nothing means the sync it blessed moved
+    src = (
+        "def hot(x):\n"
+        "    # sync-ok: the sync was refactored away\n"
+        "    return x\n"
+    )
+    vs, _, _ = hostsync.lint_source(src, "toy.py", _HOT)
+    assert [v.rule for v in vs] == ["host-sync/stale-pragma"]
+
+
+# ---------------------------------------------------------------------------
+# dtype-headroom advisor
+# ---------------------------------------------------------------------------
+
+
+def _headroom_program(max_steps):
+    class _Spec:
+        n = 3
+        n_clients = 2
+        commands_per_client = 3
+
+    _Spec.max_steps = max_steps
+
+    def ident(st):
+        return st
+
+    st = {"step": jnp.int32(0), "next_seq": jnp.zeros((2,), jnp.int32),
+          "now": jnp.int32(0)}
+    prog = checker.program_from_traced(
+        jax.jit(ident).trace(st), name="toy.headroom", kind="toy",
+        state_in_prefix="[0]", state_out_prefix="",
+    )
+    prog.spec = _Spec()
+    return prog
+
+
+def test_headroom_claims_narrowable_leaves():
+    adv = headroom.HeadroomAdvisor().advise(_headroom_program(1000))
+    by_leaf = {a["leaf"]: a for a in adv}
+    # step bounded by max_steps=1000 -> fits int16 (2000 <= 32767);
+    # next_seq bounded by commands_per_client=3 -> fits int8
+    assert by_leaf["step"]["suggested"] == "int16"
+    assert by_leaf["next_seq"]["suggested"] == "int8"
+    # `now` (a timestamp) has no spec-derived bound: never claimed
+    assert "now" not in by_leaf
+    for a in adv:
+        assert a["rule"] == "dtype-headroom/fits"
+
+
+def test_headroom_claim_retracted_by_widened_max_steps():
+    """The retraction direction is the load-bearing one: widen max_steps
+    past int16's 2x headroom and the step claim must disappear (not
+    silently stay stale)."""
+    adv = headroom.HeadroomAdvisor().advise(_headroom_program(100_000))
+    leaves = {a["leaf"] for a in adv}
+    assert "step" not in leaves  # 2 * 100000 > 32767: no claim
+    assert "next_seq" in leaves  # still bounded by commands_per_client
+
+
+def test_headroom_rides_run_check_as_advisory():
+    """Advisories land in the report's `advisories` list and NEVER fail
+    the run — `ok` stays judged on violations alone."""
+    prog = _headroom_program(1000)
+    report = checker.run_check(
+        [prog], rules=(), retrace=False,
+        advisors=(headroom.HeadroomAdvisor(),),
+    )
+    assert report["ok"]
+    assert report["rules"] == ["dtype-headroom"]
+    assert {a["leaf"] for a in report["advisories"]} == {"step", "next_seq"}
+
+
+# ---------------------------------------------------------------------------
 # negative: recompile-key hygiene
 # ---------------------------------------------------------------------------
 
@@ -484,3 +827,21 @@ def test_cli_lint_clean_and_seeded(capsys, monkeypatch):
                "--faults", "On"])
     assert rc == 2
     assert "on,off" in capsys.readouterr().err
+
+
+def test_cli_lint_host_sync_only(capsys):
+    """`lint --host-sync` is pure source analysis: traces nothing, exits
+    green on the shipped hot paths, and is NOT the vacuous-pass class (0
+    programs traced is legitimate here — files scanned is the guard)."""
+    import json
+
+    from fantoch_tpu.__main__ import main
+
+    rc = main(["lint", "--host-sync", "--json"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert out["ok"] and out["violations"] == []
+    assert out["programs"] == []  # nothing traced
+    assert out["rules"] == ["host-sync"]
+    assert out["host_sync"]["files"] == len(hostsync.HOT_PATHS)
+    assert out["host_sync"]["sanctioned"] == 2

@@ -97,8 +97,12 @@ def test_bench_smoke_all_six_protocols(tmp_path):
     assert lint, "no lint digest in the smoke aggregate"
     assert lint["ok"] is True and lint["violations"] == 0, lint
     assert lint["programs"] > 0
-    assert set(lint["rules"]) == {"purity", "dtype", "donation",
-                                  "static-keys", "hlo-size"}
+    # every rule family must ride the digest — the base contract rules plus
+    # the resource analyzer's memory budgets, the host-sync AST lint and the
+    # dtype-headroom advisor (bench runs lint() with default families)
+    assert {"purity", "dtype", "donation", "static-keys", "hlo-size",
+            "memory", "host-sync", "dtype-headroom"} <= set(lint["rules"])
+    assert "memory" in lint["rules"], lint
 
     # incremental aggregates: at least one partial line must precede the
     # final one (the crash-containment property the round-4/5 benches

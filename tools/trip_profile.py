@@ -271,6 +271,50 @@ def compare_drivers(name, B=64, chunk_steps=None, k=4, cmds=25):
         )
     assert xev == ev, f"trace run diverged: {xev} != {ev} events"
 
+    # static memory cross-check (fantoch_tpu/analysis/memory): the
+    # live-range peak estimate the memory-budget rule enforces must stay
+    # within CROSSCHECK_TOLERANCE of the backend's MEASURED buffer
+    # assignment (argument + output + temp - aliased) on the same
+    # megachunk program, in either direction — the estimator knows
+    # nothing of fusion (which shrinks the real temp set, so estimates
+    # run ~2x HIGH on this backend) and a drift past the factor means it
+    # stopped describing the program: budgets built from it would be
+    # fiction. Hard-fail, same as the purity disagreement above.
+    from fantoch_tpu.analysis import memory as mem_analysis
+
+    est = mem_analysis.estimate_traced(traced)
+    ma = None
+    try:
+        ma = traced.lower().compile().memory_analysis()
+    except Exception:
+        pass
+    if ma is None or not hasattr(ma, "temp_size_in_bytes"):
+        # some backends expose no memory analysis — record the skip
+        # instead of silently passing
+        out["static_memory"] = {"estimated": est, "measured": None,
+                                "skipped": "memory_analysis unavailable"}
+    else:
+        measured = int(
+            ma.argument_size_in_bytes + ma.output_size_in_bytes
+            + ma.temp_size_in_bytes - ma.alias_size_in_bytes
+        )
+        ratio = est["peak"] / max(measured, 1)
+        tol = mem_analysis.CROSSCHECK_TOLERANCE
+        out["static_memory"] = {
+            "estimated": est,
+            "measured_bytes": measured,
+            "ratio": round(ratio, 2),
+            "tolerance": tol,
+        }
+        if not (1.0 / tol <= ratio <= tol):
+            raise SystemExit(
+                f"{name}: static peak estimate {est['peak']} bytes is"
+                f" {ratio:.2f}x the measured {measured} bytes — outside"
+                f" the {tol}x cross-check tolerance; the memory estimator"
+                " (analysis/memory.py) has drifted from reality and the"
+                " committed memory budgets cannot be trusted"
+            )
+
     print(f"{name}: chunk {n} dispatches / {dt:.2f}s vs megachunk(k={k}) "
           f"{m} dispatches / {mdt:.2f}s -> {out['sync_reduction']}x fewer"
           f" host syncs; trace-enabled megachunk {mt} dispatches /"
